@@ -1,0 +1,27 @@
+"""moco_tpu — a TPU-native Momentum-Contrast (MoCo) self-supervised learning framework.
+
+Built from scratch in JAX/XLA for TPU hardware. Capability parity target is the
+bl0/moco reference (a fork of facebookresearch/moco); see SURVEY.md at the repo
+root for the full structural analysis this package is built against.
+
+Design stance (SURVEY.md §7): the entire training step — query/key forwards,
+momentum (EMA) key-encoder update, ShuffleBN collectives, the negative-key
+queue enqueue, InfoNCE, gradient psum and the optimizer update — is ONE jitted
+SPMD program over a `jax.sharding.Mesh`, with all state in an explicit pytree
+and the queue as a donated HBM buffer. There is no DDP wrapper, no
+process-per-device, no `no_grad` context: `stop_gradient` + functional updates
+instead.
+
+Package layout:
+    parallel/   device mesh, distributed init, collectives (ShuffleBN)
+    ops/        queue, EMA, losses, schedules, kNN, augmentation math
+    models/     flax ResNet-18/34/50 and ViT-S/16 encoders + MoCo heads
+    data/       input pipelines (synthetic, CIFAR-10, ImageFolder) + host loader
+    evals/      linear probe and kNN evaluation drivers
+    utils/      meters, logging, profiling helpers
+    train_state.py / train_step.py / train.py   pretrain state + SPMD step + CLI
+    config.py   dataclass configs; the five BASELINE.json presets
+    checkpoint.py  Orbax checkpointing + torchvision-name exporter
+"""
+
+__version__ = "0.1.0"

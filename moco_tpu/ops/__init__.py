@@ -1,0 +1,25 @@
+from moco_tpu.ops.queue import init_queue, dequeue_and_enqueue
+from moco_tpu.ops.ema import ema_update, momentum_schedule
+from moco_tpu.ops.losses import (
+    l2_normalize,
+    infonce_logits,
+    softmax_cross_entropy,
+    contrastive_accuracy,
+    v3_contrastive_loss,
+)
+from moco_tpu.ops.schedules import cosine_lr, step_lr, warmup_cosine_lr
+
+__all__ = [
+    "init_queue",
+    "dequeue_and_enqueue",
+    "ema_update",
+    "momentum_schedule",
+    "l2_normalize",
+    "infonce_logits",
+    "softmax_cross_entropy",
+    "contrastive_accuracy",
+    "v3_contrastive_loss",
+    "cosine_lr",
+    "step_lr",
+    "warmup_cosine_lr",
+]

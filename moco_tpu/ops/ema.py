@@ -1,0 +1,38 @@
+"""Momentum (EMA) key-encoder update (rebuild of `_momentum_update_key_encoder`,
+`moco/builder.py:≈L47-54`) and the MoCo-v3 momentum ramp (SURVEY §2.9).
+
+The reference mutates the key encoder's parameters in a `no_grad` loop:
+`p_k = p_k*m + p_q*(1-m)`. Functionally in JAX this is one fused tree-map —
+a device-side weighted add over the whole parameter pytree (the north-star's
+wording), executed identically on every replica so the key params stay
+bit-identical with zero communication.
+
+Parameters only: the key encoder's BatchNorm *running stats* are NOT EMA'd —
+they evolve through the key encoder's own forward passes, exactly as in the
+reference (SURVEY §2.2 row 2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ema_update(params_k, params_q, momentum) -> dict:
+    """`p_k ← m·p_k + (1−m)·p_q` over the whole pytree. `momentum` may be a
+    python float or a traced scalar (the v3 cosine ramp passes a traced one)."""
+    return jax.tree.map(
+        lambda k, q: (k * momentum + q.astype(k.dtype) * (1.0 - momentum)).astype(
+            k.dtype  # keep the key dtype even when a traced f32 momentum promotes
+        ),
+        params_k,
+        params_q,
+    )
+
+
+def momentum_schedule(base_m: float, step, total_steps: int):
+    """MoCo-v3 momentum ramp: m cosine-increases from `base_m` to 1.0 over
+    training (arXiv:2104.02057 §4; sibling-repo `main_moco.py` adjusts per
+    iteration). v1/v2 use a constant m=0.999 and never call this."""
+    frac = jnp.asarray(step, jnp.float32) / max(total_steps, 1)
+    return 1.0 - (1.0 - base_m) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))

@@ -1,0 +1,92 @@
+"""Weighted-kNN classification on frozen features (BASELINE config 4; SURVEY
+§2.5, §3.3 — the InstDisc protocol used by every MoCo kNN monitor).
+
+Protocol: cosine similarity of each query feature against a normalized
+feature bank, top-`k` neighbors (200), votes weighted `exp(sim / T)` with
+T=0.07, argmax class. Zero trainable parameters.
+
+TPU mapping: the similarity is ONE `[B, dim] x [N_bank, dim]^T` matmul
+(MXU-friendly, SURVEY §3.3); `lax.top_k` runs on-device; the class vote is a
+one-hot einsum rather than a scatter so the whole classifier is a fused,
+static-shaped XLA program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from moco_tpu.ops.losses import l2_normalize
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes", "k"))
+def _knn_predict_prenormalized(
+    feats: jax.Array,         # [B, dim] L2-normalized queries
+    bank: jax.Array,          # [N, dim] L2-normalized bank
+    bank_labels: jax.Array,   # [N] int labels
+    num_classes: int,
+    k: int = 200,
+    temperature: float = 0.07,
+) -> jax.Array:
+    sims = jnp.einsum("bc,nc->bn", feats, bank, preferred_element_type=jnp.float32)
+    k = min(k, bank.shape[0])
+    top_sims, top_idx = lax.top_k(sims, k)                      # [B, k]
+    weights = jnp.exp(top_sims / temperature)
+    neigh_labels = bank_labels[top_idx]                          # [B, k]
+    onehot = jax.nn.one_hot(neigh_labels, num_classes, dtype=jnp.float32)
+    votes = jnp.einsum("bk,bkc->bc", weights, onehot)
+    return jnp.argmax(votes, axis=-1)
+
+
+def knn_predict(
+    features: jax.Array,
+    bank: jax.Array,
+    bank_labels: jax.Array,
+    num_classes: int,
+    k: int = 200,
+    temperature: float = 0.07,
+) -> jax.Array:
+    """Return predicted class ids `[B]` (normalizes both sides; for repeated
+    calls against the same bank use `knn_accuracy`, which normalizes once)."""
+    return _knn_predict_prenormalized(
+        l2_normalize(features.astype(jnp.float32)),
+        l2_normalize(bank.astype(jnp.float32)),
+        bank_labels,
+        num_classes,
+        k=k,
+        temperature=temperature,
+    )
+
+
+def knn_accuracy(
+    features: jax.Array,
+    labels: jax.Array,
+    bank: jax.Array,
+    bank_labels: jax.Array,
+    num_classes: int,
+    k: int = 200,
+    temperature: float = 0.07,
+    batch: int = 512,
+) -> float:
+    """Top-1 kNN accuracy, evaluated in fixed-size batches so the similarity
+    matrix never exceeds `[batch, N_bank]` in HBM. The bank is normalized
+    ONCE, and the ragged final batch is padded to `batch` rows so the jitted
+    kernel compiles exactly once."""
+    n = features.shape[0]
+    feats = l2_normalize(jnp.asarray(features, jnp.float32))
+    bank = l2_normalize(jnp.asarray(bank, jnp.float32))
+    correct = 0
+    for start in range(0, n, batch):
+        f = feats[start : start + batch]
+        y = labels[start : start + batch]
+        valid = f.shape[0]
+        if valid < batch:
+            f = jnp.pad(f, ((0, batch - valid), (0, 0)))
+        pred = _knn_predict_prenormalized(
+            f, bank, bank_labels, num_classes, k=k, temperature=temperature
+        )
+        correct += int(jnp.sum(pred[:valid] == y))
+    return correct / n

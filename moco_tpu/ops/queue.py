@@ -1,0 +1,63 @@
+"""The negative-key queue (TPU-native rebuild of `moco/builder.py:≈L40-70`).
+
+The reference holds the queue as a `[dim, K]` module buffer and enqueues with
+a sliced assignment under `no_grad`. Here the queue is an ordinary array in
+the train-state pytree:
+
+- Stored `[K, dim]` (row-major keys) so the enqueue is a single
+  `lax.dynamic_update_slice_in_dim` over rows and the negatives logits are a
+  `[B, dim] x [K, dim]^T` matmul — both MXU/HBM friendly. The reference's
+  `[dim, K]` layout exists only to make `queue[:, ptr:ptr+bs] = keys.T` read
+  nicely in torch; the transposition is a layout choice, not semantics.
+- In-place semantics come from BUFFER DONATION: the train step is jitted with
+  the state donated, so XLA aliases the 65536x128 queue update into the input
+  buffer (the north-star's "donated buffer with in-place _dequeue_and_enqueue").
+- Replicated consistency: every device computes the identical enqueue from
+  the all-gathered global key batch, so no DDP-style buffer re-broadcast
+  (`broadcast_buffers`) is needed (SURVEY §2.2 note).
+
+Ordering invariant kept by the caller (train_step): enqueue happens AFTER the
+logits are computed — the current batch's keys are never their own negatives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def init_queue(key: jax.Array, num_negatives: int, dim: int, dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
+    """Random L2-normalized queue + zero pointer.
+
+    Mirrors `register_buffer("queue", F.normalize(randn(dim, K), dim=0))`
+    (`moco/builder.py:≈L38-42`), transposed to `[K, dim]` (each ROW unit-norm).
+    """
+    from moco_tpu.ops.losses import l2_normalize
+
+    q = l2_normalize(jax.random.normal(key, (num_negatives, dim), dtype=jnp.float32))
+    return q.astype(dtype), jnp.zeros((), dtype=jnp.int32)
+
+
+def dequeue_and_enqueue(
+    queue: jax.Array, ptr: jax.Array, keys: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """FIFO ring-buffer enqueue of the GLOBAL key batch.
+
+    Rebuild of `_dequeue_and_enqueue` (`moco/builder.py:≈L56-70`):
+    `queue[ptr:ptr+B] = keys; ptr = (ptr+B) % K`, with the reference's
+    `assert K % batch_size == 0` enforced statically at trace time so the
+    dynamic-slice never wraps (same precondition, checked earlier).
+
+    `keys` must already be the all-gathered global batch and stop-gradiented
+    by the caller (the reference runs this under `@torch.no_grad()`).
+    """
+    k_slots, b = queue.shape[0], keys.shape[0]
+    if k_slots % b != 0:
+        raise ValueError(
+            f"queue size {k_slots} must be divisible by global batch {b} "
+            "(reference asserts K % batch_size == 0)"
+        )
+    queue = lax.dynamic_update_slice_in_dim(queue, keys.astype(queue.dtype), ptr, axis=0)
+    new_ptr = (ptr + b) % k_slots
+    return queue, new_ptr

@@ -1,0 +1,36 @@
+"""Learning-rate schedules (rebuild of `adjust_learning_rate`,
+`main_moco.py:≈L377-388`, plus MoCo-v3's warmup+cosine, SURVEY §2.9).
+
+The reference adjusts the LR once per EPOCH (the cosine is evaluated at
+integer epochs). These helpers take a (possibly fractional) epoch so callers
+can choose per-epoch fidelity (pass `floor(epoch)`, the default in the train
+driver, matching the reference exactly) or smooth per-step decay.
+All are pure jnp so they can live inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cosine_lr(base_lr: float, epoch, total_epochs: int):
+    """`lr = base * 0.5 * (1 + cos(pi * epoch / total))` — the `--cos` branch."""
+    frac = jnp.asarray(epoch, jnp.float32) / total_epochs
+    return base_lr * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+
+
+def step_lr(base_lr: float, epoch, milestones: tuple[int, ...]):
+    """x0.1 at each milestone in `--schedule` (default 120,160) — the v1 branch."""
+    e = jnp.asarray(epoch, jnp.float32)
+    drops = sum((e >= m).astype(jnp.float32) for m in milestones)
+    return base_lr * jnp.power(0.1, drops)
+
+
+def warmup_cosine_lr(base_lr: float, epoch, total_epochs: int, warmup_epochs: int):
+    """MoCo-v3 recipe: linear warmup then cosine (arXiv:2104.02057 recipe;
+    40-epoch warmup at batch 4096)."""
+    e = jnp.asarray(epoch, jnp.float32)
+    warm = base_lr * e / jnp.maximum(warmup_epochs, 1e-8)
+    frac = (e - warmup_epochs) / jnp.maximum(total_epochs - warmup_epochs, 1e-8)
+    cos = base_lr * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+    return jnp.where(e < warmup_epochs, warm, cos)

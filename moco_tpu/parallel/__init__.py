@@ -1,0 +1,23 @@
+from moco_tpu.parallel.mesh import (
+    DATA_AXIS,
+    create_mesh,
+    force_cpu_devices,
+    local_batch_size,
+    distributed_init,
+)
+from moco_tpu.parallel.collectives import (
+    all_gather_batch,
+    batch_shuffle,
+    batch_unshuffle,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "create_mesh",
+    "force_cpu_devices",
+    "local_batch_size",
+    "distributed_init",
+    "all_gather_batch",
+    "batch_shuffle",
+    "batch_unshuffle",
+]

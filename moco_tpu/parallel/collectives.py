@@ -1,0 +1,92 @@
+"""ShuffleBN and gather collectives (TPU-native rebuild of `moco/builder.py`'s
+`concat_all_gather` / `_batch_shuffle_ddp` / `_batch_unshuffle_ddp`, SURVEY §2.2).
+
+All functions here are called INSIDE a `jax.shard_map`-mapped step over the
+1-D data mesh, so `lax.all_gather(..., axis_name)` compiles to a single XLA
+all-gather over ICI. Differences from the NCCL reference, by design:
+
+- The reference generates the shuffle permutation on rank 0 and broadcasts it
+  (`moco/builder.py:≈L72-98`, one NCCL broadcast per step). Here every device
+  computes the SAME permutation from a shared, replicated PRNG key
+  (`jax.random.permutation(key, B)`): deterministic ⇒ consistent ⇒ the
+  broadcast disappears entirely (zero comm).
+- `concat_all_gather` in the reference is explicitly non-differentiable (it
+  is only used under `no_grad`). `lax.all_gather` IS differentiable, so
+  callers that need the reference's stop-grad semantics wrap results in
+  `lax.stop_gradient` (the train step does this for the key path).
+
+Replication-typing note (jax 0.9): `lax.all_gather` output is typed
+"varying" over the mapped axis even though its value is device-invariant
+(there is no `all_gather_invariant` in this version). Consequently updates to
+REPLICATED state (queue, params) that derive from gathered values must happen
+at the outer jit level, outside the shard_map region — the train step is a
+hybrid: `jit(outer)` does EMA/optimizer/queue updates under the automatic
+partitioner, and the inner `shard_map` region does only the per-device work
+(ShuffleBN, forwards, local grads + psum). This keeps `check_vma` on.
+
+Why ShuffleBN exists (SURVEY §0.1): with per-device BatchNorm, the query and
+its positive key would share BN statistics if they sat on the same device,
+leaking which in-batch sample is the positive. Shuffling the key batch
+across devices before the key encoder's forward decorrelates the BN groups;
+unshuffling after restores q/k alignment.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_gather_batch(x: jax.Array, axis_name: str) -> jax.Array:
+    """Gather local batch shards into the global batch along dim 0.
+
+    Equivalent of `concat_all_gather` (`moco/builder.py:≈L167-180`) minus the
+    stop-grad (callers add it where the reference ran under no_grad).
+    """
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def batch_shuffle(
+    x: jax.Array, key: jax.Array, axis_name: str
+) -> tuple[jax.Array, jax.Array]:
+    """Shuffle the global batch across devices; return (local shard, perm).
+
+    Rebuild of `_batch_shuffle_ddp` (`moco/builder.py:≈L72-98`):
+      all-gather → same permutation everywhere (shared PRNG key instead of a
+      rank-0 broadcast) → each device keeps its contiguous slice.
+
+    `key` MUST be replicated across the mesh (derived by `fold_in` from the
+    replicated train-state key) — divergent keys would silently desynchronise
+    the shuffle; tests/test_collectives.py pins this.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    x_all = all_gather_batch(x, axis_name)  # [B_global, ...]
+    global_b = x_all.shape[0]
+    perm = jax.random.permutation(key, global_b)
+    local_idx = lax.dynamic_slice_in_dim(perm, idx * (global_b // n), global_b // n)
+    return jnp.take(x_all, local_idx, axis=0), perm
+
+
+def batch_unshuffle(x: jax.Array, perm: jax.Array, axis_name: str) -> jax.Array:
+    """Undo `batch_shuffle` (rebuild of `_batch_unshuffle_ddp`,
+    `moco/builder.py:≈L100-115`): gather the shuffled global batch, index it
+    with this device's slice of the inverse permutation."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    x_all = all_gather_batch(x, axis_name)
+    global_b = x_all.shape[0]
+    inv = jnp.argsort(perm)
+    local_idx = lax.dynamic_slice_in_dim(inv, idx * (global_b // n), global_b // n)
+    return jnp.take(x_all, local_idx, axis=0)
+
+
+def ring_shuffle(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
+    """Cheaper ShuffleBN variant: rotate whole local batches around the ring
+    with a single `ppermute` (SURVEY §2.11 notes this decorrelates BN groups
+    at a fraction of the cost of gather+permute; the all-gather version above
+    stays the semantically faithful default). Self-inverse via `-shift`."""
+    n = lax.axis_size(axis_name)
+    pairs = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=pairs)

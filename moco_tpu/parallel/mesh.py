@@ -1,0 +1,104 @@
+"""Device mesh + process topology (layer L0/L1 of SURVEY.md §1).
+
+The reference scales with one POSIX process per GPU launched by `mp.spawn`
+and a NCCL process group (`main_moco.py:≈L114-155`). TPU-native equivalent:
+a single controller process per *host* drives all local chips, the SPMD
+program is compiled once over a `jax.sharding.Mesh`, and multi-host
+bootstrap is `jax.distributed.initialize()` (replacing the tcp:// / env://
+rendezvous of `torch.distributed.init_process_group`). Collectives are
+compiled into the step program over ICI/DCN — there is no user-visible
+process-group object.
+
+MoCo's only parallelism is data parallelism (SURVEY.md §2.11), so the mesh
+is 1-D over `DATA_AXIS`. TP/PP/EP are structurally absent from the reference
+and deliberately not built (SURVEY.md §7 non-goals); pjit makes them
+available later by re-sharding if ever needed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# The single mesh axis used by the whole framework. Batch dim is sharded over
+# it; params/queue/opt-state are replicated over it.
+DATA_AXIS = "data"
+
+
+def force_cpu_devices(n: int = 8) -> None:
+    """Force this process onto `n` fake CPU devices (test/simulation mode).
+
+    Replaces the reference's "just run it on 8 V100s" validation story
+    (SURVEY.md §4): `--xla_force_host_platform_device_count=N` gives N real
+    XLA CPU devices in one process with real all_gather/psum/ppermute
+    semantics. Must run before the first JAX backend query.
+
+    Note: the environment's sitecustomize force-registers a TPU ("axon")
+    platform and overrides `JAX_PLATFORMS`, so setting the env var alone is
+    not enough — we also set the config in-process.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+
+def distributed_init(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bootstrap (replaces `dist.init_process_group`, SURVEY §5.8).
+
+    On Cloud TPU all three args are auto-detected from the metadata server
+    (pass nothing); explicit args support manual rendezvous. Callers invoke
+    this only for multi-host jobs (the train driver's `--multihost` path) —
+    `num_processes=1` is the explicit single-process no-op.
+    """
+    if num_processes == 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def create_mesh(num_devices: int | None = None, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build the 1-D data-parallel mesh over all (or the first N) devices."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices but only {len(devices)} present"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding for replicated state (params, queue, opt state)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh) -> NamedSharding:
+    """Sharding for a batch: leading dim split over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    """Per-device batch (the reference's `batch_size / ngpus_per_node`,
+    `main_moco.py:≈L230`). Global batch must divide evenly: the queue ring
+    update requires `K % global_batch == 0` and XLA requires even sharding."""
+    n = mesh.size
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by mesh size {n}")
+    return global_batch // n

@@ -1,0 +1,29 @@
+"""Test configuration: run everything on 8 fake CPU devices.
+
+This is the framework's replacement for the reference's "validate on 8 real
+V100s" story (SURVEY.md §4): `--xla_force_host_platform_device_count=8`
+provides real XLA CPU devices with real all_gather/psum/ppermute semantics,
+so every collective path (ShuffleBN, enqueue gather, grad psum, v3 in-batch
+negatives) is exercised without hardware. Must run before JAX initializes a
+backend — hence module scope, before any jax-importing test module loads.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from moco_tpu.parallel.mesh import create_mesh
+
+    return create_mesh(8)

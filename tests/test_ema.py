@@ -1,0 +1,33 @@
+"""EMA math property tests (SURVEY §4 item 2: `p_k' = m·p_k + (1-m)·p_q` exactly)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moco_tpu.ops.ema import ema_update, momentum_schedule
+
+
+def test_ema_exact():
+    pk = {"w": jnp.full((3,), 2.0), "nested": {"b": jnp.full((2, 2), -1.0)}}
+    pq = {"w": jnp.full((3,), 4.0), "nested": {"b": jnp.full((2, 2), 3.0)}}
+    out = ema_update(pk, pq, 0.999)
+    np.testing.assert_allclose(np.asarray(out["w"]), 2.0 * 0.999 + 4.0 * 0.001, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(out["nested"]["b"]), -1.0 * 0.999 + 3.0 * 0.001, rtol=1e-6
+    )
+
+
+def test_ema_momentum_one_freezes():
+    pk = {"w": jnp.ones(3)}
+    pq = {"w": jnp.zeros(3)}
+    np.testing.assert_array_equal(np.asarray(ema_update(pk, pq, 1.0)["w"]), 1.0)
+
+
+def test_momentum_schedule_ramp():
+    m0 = momentum_schedule(0.99, 0, 100)
+    m_half = momentum_schedule(0.99, 50, 100)
+    m_end = momentum_schedule(0.99, 100, 100)
+    assert np.isclose(float(m0), 0.99, atol=1e-6)
+    assert np.isclose(float(m_half), 0.995, atol=1e-6)
+    assert np.isclose(float(m_end), 1.0, atol=1e-6)
+    assert float(m0) < float(m_half) < float(m_end)

@@ -1,0 +1,37 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moco_tpu.ops.knn import knn_accuracy, knn_predict
+
+
+def _clusters(key, n_per_class, num_classes, dim, spread=0.1):
+    keys = jax.random.split(key, num_classes)
+    centers = jax.random.normal(jax.random.key(123), (num_classes, dim)) * 3
+    feats, labels = [], []
+    for c in range(num_classes):
+        pts = centers[c] + spread * jax.random.normal(keys[c], (n_per_class, dim))
+        feats.append(pts)
+        labels.append(jnp.full((n_per_class,), c, jnp.int32))
+    return jnp.concatenate(feats), jnp.concatenate(labels)
+
+
+def test_knn_separable_clusters_perfect():
+    bank, bank_labels = _clusters(jax.random.key(0), 50, 4, 16)
+    queries, qlabels = _clusters(jax.random.key(1), 10, 4, 16)
+    pred = knn_predict(queries, bank, bank_labels, num_classes=4, k=20)
+    np.testing.assert_array_equal(np.asarray(pred), np.asarray(qlabels))
+
+
+def test_knn_accuracy_batched_matches():
+    bank, bank_labels = _clusters(jax.random.key(2), 40, 3, 8)
+    queries, qlabels = _clusters(jax.random.key(3), 30, 3, 8)
+    acc = knn_accuracy(queries, qlabels, bank, bank_labels, num_classes=3, k=10, batch=7)
+    assert acc == 1.0
+
+
+def test_knn_k_larger_than_bank_clamps():
+    bank, bank_labels = _clusters(jax.random.key(4), 5, 2, 8)
+    queries, qlabels = _clusters(jax.random.key(5), 4, 2, 8)
+    pred = knn_predict(queries, bank, bank_labels, num_classes=2, k=200)
+    assert pred.shape == (8,)

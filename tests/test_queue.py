@@ -1,0 +1,52 @@
+"""Queue FIFO property tests (SURVEY §4 item 2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.ops.queue import dequeue_and_enqueue, init_queue
+
+
+def test_init_queue_normalized():
+    q, ptr = init_queue(jax.random.key(0), 128, 16)
+    assert q.shape == (128, 16)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(q), axis=1), 1.0, rtol=1e-5)
+    assert int(ptr) == 0
+
+
+def test_enqueue_fifo_and_wraparound():
+    k_slots, dim, b = 16, 4, 4
+    queue = jnp.zeros((k_slots, dim))
+    ptr = jnp.zeros((), jnp.int32)
+    # fill exactly K/b batches, then one more to test wraparound overwrite
+    for i in range(k_slots // b):
+        keys = jnp.full((b, dim), float(i + 1))
+        queue, ptr = dequeue_and_enqueue(queue, ptr, keys)
+    assert int(ptr) == 0  # wrapped exactly at ptr+bs == K
+    q = np.asarray(queue)
+    for i in range(k_slots // b):
+        np.testing.assert_array_equal(q[i * b : (i + 1) * b], float(i + 1))
+    # one more batch overwrites the OLDEST slots (rows 0:b)
+    queue, ptr = dequeue_and_enqueue(queue, ptr, jnp.full((b, dim), 99.0))
+    q = np.asarray(queue)
+    np.testing.assert_array_equal(q[:b], 99.0)
+    np.testing.assert_array_equal(q[b : 2 * b], 2.0)
+    assert int(ptr) == b
+
+
+def test_enqueue_requires_divisibility():
+    queue = jnp.zeros((10, 4))
+    with pytest.raises(ValueError, match="divisible"):
+        dequeue_and_enqueue(queue, jnp.zeros((), jnp.int32), jnp.zeros((3, 4)))
+
+
+def test_enqueue_under_jit_donation():
+    """The queue update must be expressible with the state buffer donated
+    (the north-star's in-place HBM queue)."""
+    queue = jnp.zeros((8, 2))
+    ptr = jnp.zeros((), jnp.int32)
+    f = jax.jit(dequeue_and_enqueue, donate_argnums=(0,))
+    queue2, ptr2 = f(queue, ptr, jnp.ones((2, 2)))
+    assert int(ptr2) == 2
+    np.testing.assert_array_equal(np.asarray(queue2)[:2], 1.0)

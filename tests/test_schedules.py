@@ -1,0 +1,24 @@
+import numpy as np
+
+from moco_tpu.ops.schedules import cosine_lr, step_lr, warmup_cosine_lr
+
+
+def test_cosine_endpoints():
+    assert np.isclose(float(cosine_lr(0.03, 0, 200)), 0.03)
+    assert np.isclose(float(cosine_lr(0.03, 100, 200)), 0.015)
+    assert np.isclose(float(cosine_lr(0.03, 200, 200)), 0.0, atol=1e-9)
+
+
+def test_step_schedule_reference_defaults():
+    # reference defaults: --lr 0.03 --schedule 120 160
+    assert np.isclose(float(step_lr(0.03, 0, (120, 160))), 0.03)
+    assert np.isclose(float(step_lr(0.03, 119, (120, 160))), 0.03)
+    assert np.isclose(float(step_lr(0.03, 120, (120, 160))), 0.003)
+    assert np.isclose(float(step_lr(0.03, 160, (120, 160))), 0.0003)
+
+
+def test_warmup_cosine():
+    assert np.isclose(float(warmup_cosine_lr(1.0, 0, 300, 40)), 0.0)
+    assert np.isclose(float(warmup_cosine_lr(1.0, 20, 300, 40)), 0.5)
+    assert np.isclose(float(warmup_cosine_lr(1.0, 40, 300, 40)), 1.0)
+    assert float(warmup_cosine_lr(1.0, 300, 300, 40)) < 1e-6
