@@ -1,0 +1,163 @@
+"""Flat dataclass configs + the five BASELINE.json presets (SURVEY §5.6).
+
+The reference's config system is one flat argparse namespace per driver
+(`main_moco.py:≈L28-100`, re-declared with different defaults in
+`main_lincls.py:≈L40-90`); the v1→v2 switch is three booleans and a
+temperature on the CLI. We keep that shape — a flat dataclass per driver,
+argparse front-end in the drivers — and name the five BASELINE configs as
+presets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PretrainConfig:
+    # experiment
+    name: str = "moco"
+    variant: str = "v2"               # "v1" | "v2" | "v3"
+    seed: int = 0
+    # model (reference flags -a/--arch, --moco-dim/k/m/t, --mlp)
+    arch: str = "resnet50"            # resnet18/34/50/101 | vit_small/vit_base
+    embed_dim: int = 128              # --moco-dim
+    num_negatives: int = 65536        # --moco-k (ignored for v3)
+    momentum_ema: float = 0.999       # --moco-m (v3: base for cosine ramp, 0.99)
+    temperature: float = 0.07         # --moco-t (v2 runs use 0.2)
+    mlp_head: bool = False            # --mlp
+    cifar_stem: bool = False
+    compute_dtype: str = "float32"    # "bfloat16" on TPU
+    sync_bn: bool = False             # per-device BN is the MoCo default
+    # data
+    dataset: str = "synthetic"        # synthetic | cifar10 | imagefolder
+    data_dir: str = ""
+    image_size: int = 224
+    aug_plus: bool = False            # --aug-plus (v2 aug stack)
+    num_workers: int = 4              # host-side loader threads (-j)
+    # optimization (reference: SGD momentum .9, wd 1e-4, lr .03, batch 256)
+    optimizer: str = "sgd"            # sgd | adamw | lars
+    lr: float = 0.03
+    batch_size: int = 256             # GLOBAL batch
+    epochs: int = 200
+    warmup_epochs: int = 0            # v3: 40
+    schedule: tuple[int, ...] = (120, 160)  # --schedule milestones (v1 path)
+    cos: bool = False                 # --cos
+    sgd_momentum: float = 0.9
+    weight_decay: float = 1e-4
+    momentum_ramp: bool = False       # v3 cosine m→1 ramp
+    # bookkeeping
+    print_freq: int = 10              # -p
+    ckpt_dir: str = "checkpoints"
+    ckpt_every_epochs: int = 1
+    resume: str = ""                  # path | "auto"
+    steps_per_epoch: int | None = None  # derived from dataset unless set
+    knn_monitor: bool = False         # periodic kNN top-1 during pretrain
+    num_classes: int = 1000           # dataset classes (kNN/eval only)
+
+    def replace(self, **kw) -> "PretrainConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass
+class EvalConfig:
+    """Linear probe (`main_lincls.py` defaults) + kNN settings."""
+
+    arch: str = "resnet50"
+    pretrained: str = ""              # --pretrained checkpoint path
+    dataset: str = "imagefolder"
+    data_dir: str = ""
+    image_size: int = 224
+    cifar_stem: bool = False
+    num_classes: int = 1000
+    seed: int = 0
+    # lincls recipe: lr 30, epochs 100, milestones 60/80, wd 0, batch 256
+    lr: float = 30.0
+    batch_size: int = 256
+    epochs: int = 100
+    schedule: tuple[int, ...] = (60, 80)
+    cos: bool = False
+    sgd_momentum: float = 0.9
+    weight_decay: float = 0.0
+    # kNN protocol (SURVEY §2.5): top-200 neighbors, T=0.07
+    knn_k: int = 200
+    knn_temperature: float = 0.07
+    print_freq: int = 10
+    ckpt_dir: str = "lincls_checkpoints"
+
+    def replace(self, **kw) -> "EvalConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# The five BASELINE.json target configs as named presets.
+# ---------------------------------------------------------------------------
+
+PRESETS: dict[str, PretrainConfig | EvalConfig] = {
+    # 1. MoCo-v1 ResNet-18 CIFAR-10, K=4096, single-process (CPU smoke ref)
+    "cifar10-moco-v1": PretrainConfig(
+        name="cifar10-moco-v1",
+        variant="v1",
+        arch="resnet18",
+        num_negatives=4096,
+        temperature=0.07,
+        cifar_stem=True,
+        dataset="cifar10",
+        image_size=32,
+        batch_size=256,
+        epochs=200,
+        cos=False,
+        knn_monitor=True,
+        num_classes=10,
+    ),
+    # 2. MoCo-v2 ResNet-50 ImageNet-1k, K=65536, MLP head, cosine LR
+    "imagenet-moco-v2": PretrainConfig(
+        name="imagenet-moco-v2",
+        variant="v2",
+        arch="resnet50",
+        num_negatives=65536,
+        temperature=0.2,
+        mlp_head=True,
+        aug_plus=True,
+        cos=True,
+        dataset="imagefolder",
+        compute_dtype="bfloat16",
+    ),
+    # 4. Linear-probe + kNN eval on frozen MoCo-v2 features
+    "imagenet-lincls": EvalConfig(),
+    # 5. MoCo-v3 ViT-S/16, queue-free large-batch contrastive
+    "imagenet-moco-v3-vits": PretrainConfig(
+        name="imagenet-moco-v3-vits",
+        variant="v3",
+        arch="vit_small",
+        embed_dim=256,
+        momentum_ema=0.99,
+        momentum_ramp=True,
+        temperature=0.2,
+        optimizer="adamw",
+        lr=1.5e-4 * 4096 / 256,
+        weight_decay=0.1,
+        batch_size=4096,
+        epochs=300,
+        warmup_epochs=40,
+        cos=True,
+        aug_plus=True,
+        dataset="imagefolder",
+        compute_dtype="bfloat16",
+    ),
+}
+
+
+# 3. Same recipe, ShuffleBN across 8 chips (v3-8) — identical step program by
+# construction (derived, so the two can never silently fork); the mesh size
+# comes from the hardware.
+PRESETS["imagenet-moco-v2-8chip"] = PRESETS["imagenet-moco-v2"].replace(
+    name="imagenet-moco-v2-8chip"
+)
+
+
+def get_preset(name: str):
+    if name not in PRESETS:
+        raise ValueError(f"unknown preset {name!r}; choose from {sorted(PRESETS)}")
+    return PRESETS[name]

@@ -1,0 +1,24 @@
+from moco_tpu.models.resnet import (
+    ARCHS,
+    FEATURE_DIMS,
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    build_resnet,
+)
+from moco_tpu.models.heads import V3Predictor, V3Projector
+
+__all__ = [
+    "ARCHS",
+    "FEATURE_DIMS",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "build_resnet",
+    "V3Predictor",
+    "V3Projector",
+]

@@ -1,0 +1,71 @@
+"""MoCo projection / prediction heads.
+
+- The v2 head (Linear→ReLU→Linear, `moco/builder.py:≈L25-35`) is built into
+  `ResNet(mlp_head=True)` since the reference splices it in place of `fc`.
+- v3 heads (sibling repo `moco-v3/moco/builder.py`, SURVEY §2.9): projector =
+  3-layer MLP, hidden 4096, out 256, BN after every linear, no affine+no ReLU
+  after the last BN; predictor (query side only) = 2-layer MLP, hidden 4096,
+  BN+ReLU between. Both operate on [B, D] vectors, dtype float32 (head math
+  is tiny; keeping it f32 sidesteps bf16 BN-stat noise).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _MLP(nn.Module):
+    num_layers: int
+    hidden_dim: int
+    out_dim: int
+    last_bn: bool
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(jnp.float32)
+        for i in range(self.num_layers):
+            last = i == self.num_layers - 1
+            dim = self.out_dim if last else self.hidden_dim
+            # every linear bias-free: hidden biases are absorbed by the BN
+            # that follows, and the reference builds all of them bias-less
+            x = nn.Dense(dim, use_bias=False, name=f"fc{i}")(x)
+            if not last:
+                x = nn.BatchNorm(
+                    use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                    name=f"bn{i}",
+                )(x)
+                x = nn.relu(x)
+            elif self.last_bn:
+                # v3: final BN without affine params ("SimCLR-style" head)
+                x = nn.BatchNorm(
+                    use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                    use_bias=False, use_scale=False, name=f"bn{i}",
+                )(x)
+        return x
+
+
+class V3Projector(nn.Module):
+    """3-layer projector, hidden 4096 → out 256, BN throughout."""
+
+    hidden_dim: int = 4096
+    out_dim: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        return _MLP(3, self.hidden_dim, self.out_dim, last_bn=True, name="mlp")(
+            x, train=train
+        )
+
+
+class V3Predictor(nn.Module):
+    """2-layer predictor on the query side only."""
+
+    hidden_dim: int = 4096
+    out_dim: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        return _MLP(2, self.hidden_dim, self.out_dim, last_bn=False, name="mlp")(
+            x, train=train
+        )

@@ -1,0 +1,185 @@
+"""Flax ResNet backbone zoo (layer L3b of SURVEY.md §1).
+
+The reference takes its encoders from `torchvision.models`
+(`models.__dict__[arch](num_classes=dim)`, `main_moco.py:≈L40-46,165`). This
+is a from-scratch flax implementation with matching structure so that (a) the
+linear-probe checkpoint surgery has the same named-part semantics (backbone
+vs final `fc`) and (b) the exporter (checkpoint.py) can emit
+torchvision-style names for downstream consumers (SURVEY §2.6).
+
+TPU-first choices:
+- NHWC layout throughout (XLA:TPU's native convolution layout; torchvision's
+  NCHW is a CUDA convention, not semantics).
+- Weights/activations can run in bfloat16 via `dtype=`, with BN statistics
+  and the parameter master copies kept in float32 (`param_dtype`).
+- BatchNorm is PER-DEVICE by default (no cross-replica axis): MoCo's
+  ShuffleBN depends on per-device statistics (SURVEY §7 hard part 1).
+  `bn_cross_replica_axis` enables SyncBN only for transfer configs that
+  want it (e.g. detection's `Base-RCNN-C4-BN`).
+
+Structure parity notes (vs torchvision `resnet.py`):
+- Bottleneck is v1.5: the stride sits on the 3x3 conv, not the 1x1.
+- Stem: 7x7/2 conv, BN, ReLU, 3x3/2 max-pool. `cifar_stem=True` swaps in the
+  community CIFAR variant (3x3/1 conv, no max-pool) used by every CIFAR MoCo
+  demo (BASELINE config 1).
+- `torch` BN defaults: momentum 0.1, eps 1e-5 → flax momentum 0.9, eps 1e-5.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """2x3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), (self.strides, self.strides), name="downsample_conv"
+            )(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class Bottleneck(nn.Module):
+    """1x1 → 3x3(stride) → 1x1(x4) residual block (ResNet-50/101/152, v1.5)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides), name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * self.expansion, (1, 1), name="conv3")(y)
+        y = self.norm(name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * self.expansion,
+                (1, 1),
+                (self.strides, self.strides),
+                name="downsample_conv",
+            )(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet encoder ending in a `num_classes`-dim `fc` head.
+
+    For MoCo pretraining `num_classes` is the embedding dim (128) and
+    `mlp_head=True` swaps `fc` for the v2 2-layer MLP head
+    (`moco/builder.py:≈L25-35`: Linear(d,d) → ReLU → Linear(d,dim)).
+    `num_classes=None` returns pooled backbone features (used by the linear
+    probe and the kNN feature bank).
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int | None = 128
+    mlp_head: bool = False
+    cifar_stem: bool = False
+    width: int = 64
+    dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
+    bn_cross_replica_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_cross_replica_axis,
+        )
+
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(self.width, (3, 3), name="conv1")(x)
+            x = norm(name="bn1")(x)
+            x = nn.relu(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv1")(x)
+            x = norm(name="bn1")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=self.width * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    name=f"layer{i + 1}_{j}",
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool → [B, feat_dim]
+        x = x.astype(jnp.float32)
+        if self.num_classes is None:
+            return x
+        dense = partial(nn.Dense, dtype=jnp.float32, param_dtype=jnp.float32)
+        if self.mlp_head:
+            d = x.shape[-1]
+            x = dense(d, name="fc_hidden")(x)
+            x = nn.relu(x)
+            x = dense(self.num_classes, name="fc")(x)
+        else:
+            x = dense(self.num_classes, name="fc")(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=Bottleneck)
+
+# `--arch` registry (the reference's `model_names`/`models.__dict__[arch]`).
+ARCHS: dict[str, Callable[..., ResNet]] = {
+    "resnet18": ResNet18,
+    "resnet34": ResNet34,
+    "resnet50": ResNet50,
+    "resnet101": ResNet101,
+}
+
+FEATURE_DIMS = {"resnet18": 512, "resnet34": 512, "resnet50": 2048, "resnet101": 2048}
+
+
+def build_resnet(arch: str, **kwargs) -> ResNet:
+    if arch not in ARCHS:
+        raise ValueError(f"unknown arch {arch!r}; choose from {sorted(ARCHS)}")
+    return ARCHS[arch](**kwargs)

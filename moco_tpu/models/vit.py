@@ -1,0 +1,130 @@
+"""Vision Transformer backbone for MoCo v3 (BASELINE config 5; SURVEY §2.9).
+
+Rebuild of the sibling repo's `vits.py` (`moco-v3`): ViT-S/16 = 12 blocks,
+width 384, 6 heads; 224² → 14×14 = 196 patch tokens + a class token.
+MoCo-v3 specifics reproduced here:
+
+- FIXED 2-D sin-cos positional embedding (not learned) — the paper's choice
+  for stability.
+- `frozen_patch_embed=True` applies `stop_gradient` to the patch-projection
+  output, so no gradient reaches the patch-embed kernel (the paper's
+  "random patch projection" stability trick). The optimizer additionally
+  masks those params out (see v3_step.patch_embed_trainable_mask) so weight
+  decay cannot move them either — together these equal the reference's
+  `requires_grad=False`.
+
+At 197 tokens the attention is tiny by TPU standards — XLA compiles it
+straight to MXU matmuls; no custom flash-attention kernel is warranted at
+this scale (SURVEY §5.7).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sincos_2d_position_embedding(h: int, w: int, dim: int) -> jnp.ndarray:
+    """Fixed 2-D sin-cos embedding `[1, h*w, dim]` (moco-v3's
+    `build_2d_sincos_position_embedding`; temperature 10000)."""
+    assert dim % 4 == 0, "sin-cos embedding needs dim divisible by 4"
+    grid_h = np.arange(h, dtype=np.float32)
+    grid_w = np.arange(w, dtype=np.float32)
+    gw, gh = np.meshgrid(grid_w, grid_h)  # [h, w] each
+    pos_dim = dim // 4
+    omega = 1.0 / (10000 ** (np.arange(pos_dim, dtype=np.float32) / pos_dim))
+    out_w = np.einsum("hw,d->hwd", gw, omega).reshape(h * w, pos_dim)
+    out_h = np.einsum("hw,d->hwd", gh, omega).reshape(h * w, pos_dim)
+    emb = np.concatenate(
+        [np.sin(out_w), np.cos(out_w), np.sin(out_h), np.cos(out_h)], axis=1
+    )
+    return jnp.asarray(emb[None], jnp.float32)
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    num_heads: int
+    mlp_ratio: float = 4.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="attn",
+        )(y, y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
+        y = nn.Dense(int(self.dim * self.mlp_ratio), dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlp_fc1")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="mlp_fc2")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    """ViT encoder; returns the class-token feature (`num_classes=None`) or a
+    linear head over it."""
+
+    patch_size: int = 16
+    width: int = 384
+    depth: int = 12
+    num_heads: int = 6
+    mlp_ratio: float = 4.0
+    num_classes: int | None = None
+    frozen_patch_embed: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, h, w, _ = x.shape
+        gh, gw = h // self.patch_size, w // self.patch_size
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width,
+            (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="patch_embed",
+        )(x)
+        x = x.reshape(b, gh * gw, self.width)
+        if self.frozen_patch_embed:
+            # moco-v3 stability trick: random, never-trained patch projection
+            x = jax.lax.stop_gradient(x)
+        x = x + sincos_2d_position_embedding(gh, gw, self.width).astype(self.dtype)
+        cls = self.param(
+            "cls_token", nn.initializers.normal(1e-6), (1, 1, self.width), jnp.float32
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.width)).astype(self.dtype), x], axis=1)
+        for i in range(self.depth):
+            x = TransformerBlock(
+                self.width, self.num_heads, self.mlp_ratio, self.dtype, name=f"block{i}"
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        feat = x[:, 0].astype(jnp.float32)  # class token
+        if self.num_classes is None:
+            return feat
+        return nn.Dense(self.num_classes, param_dtype=jnp.float32, name="head")(feat)
+
+
+ViT_Small = partial(ViT, width=384, depth=12, num_heads=6)
+ViT_Base = partial(ViT, width=768, depth=12, num_heads=12)
+
+VIT_ARCHS = {"vit_small": ViT_Small, "vit_base": ViT_Base}
+VIT_FEATURE_DIMS = {"vit_small": 384, "vit_base": 768}
+
+
+def build_vit(arch: str, num_classes: int | None = None, **kwargs) -> ViT:
+    if arch not in VIT_ARCHS:
+        raise ValueError(f"unknown vit arch {arch!r}; choose from {sorted(VIT_ARCHS)}")
+    return VIT_ARCHS[arch](num_classes=num_classes, **kwargs)

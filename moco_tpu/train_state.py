@@ -1,0 +1,73 @@
+"""The MoCo training state pytree (SURVEY §5.4 build spec).
+
+Everything the reference keeps as module/optimizer state —
+`encoder_q`/`encoder_k` parameters, BN running stats for both encoders, the
+SGD momentum buffers, the negative queue + pointer (`state_dict` buffers in
+the reference, `main_moco.py:≈L322-328`) — lives in ONE explicit, replicated
+pytree. The train step is `state' = f(state, batch)` with the state donated,
+so XLA updates params/queue in place in HBM. Checkpointing this pytree with
+Orbax is bit-faithful resume (queue and pointer included), matching the
+reference's torch.save of the full state_dict.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from moco_tpu.ops.queue import init_queue
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array                 # int32 scalar, number of completed steps
+    params_q: Any                   # query encoder params (trainable)
+    params_k: Any                   # key encoder params (EMA of params_q)
+    batch_stats_q: Any              # query-encoder BN running stats
+    batch_stats_k: Any              # key-encoder BN running stats
+    opt_state: Any                  # optax state over params_q only
+    queue: jax.Array | None         # [K, dim] negative keys (None for v3)
+    queue_ptr: jax.Array | None     # int32 ring pointer (None for v3)
+    rng: jax.Array                  # replicated base PRNG key (model-side RNG)
+
+
+def create_train_state(
+    rng: jax.Array,
+    model,
+    tx: optax.GradientTransformation,
+    input_shape: tuple[int, ...],
+    num_negatives: int | None,
+    embed_dim: int,
+    queue_dtype=jnp.float32,
+) -> TrainState:
+    """Initialise q, copy q → k (the reference's param copy,
+    `moco/builder.py:≈L20-24` — k starts identical to q), build queue.
+
+    `input_shape` is a per-device-shaped dummy `[local_b, H, W, C]`; init is
+    shape-driven only.
+    """
+    init_key, queue_key, state_key = jax.random.split(rng, 3)
+    variables = model.init(init_key, jnp.zeros(input_shape, jnp.float32), train=False)
+    params_q = variables["params"]
+    batch_stats_q = variables.get("batch_stats", {})
+    params_k = jax.tree.map(jnp.copy, params_q)
+    batch_stats_k = jax.tree.map(jnp.copy, batch_stats_q)
+    if num_negatives is not None:
+        queue, queue_ptr = init_queue(queue_key, num_negatives, embed_dim, queue_dtype)
+    else:
+        queue, queue_ptr = None, None
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params_q=params_q,
+        params_k=params_k,
+        batch_stats_q=batch_stats_q,
+        batch_stats_k=batch_stats_k,
+        opt_state=tx.init(params_q),
+        queue=queue,
+        queue_ptr=queue_ptr,
+        rng=state_key,
+    )

@@ -1,0 +1,250 @@
+"""The MoCo pretrain step as ONE jitted SPMD program (SURVEY §7 design stance).
+
+Rebuilds the whole per-step pipeline of `main_moco.py:≈L280-320` +
+`MoCo.forward` (`moco/builder.py:≈L117-165`) as a single donated-state jit:
+
+    outer jit level (replicated state, automatic partitioner):
+        EMA key-encoder update  (BEFORE the key forward — ordering invariant)
+        optimizer update from psum'd grads
+        queue enqueue            (AFTER logits — keys never their own negatives)
+    inner shard_map region (per-device semantics over the 1-D data mesh):
+        ShuffleBN shuffle → key forward (per-device BN stats) → unshuffle
+        query forward + InfoNCE + local grads → pmean (the DDP all-reduce)
+
+The hybrid split exists because replicated-state updates derived from
+`all_gather`ed values cannot be typed replicated inside shard_map (see
+moco_tpu/parallel/collectives.py); outside, XLA's partitioner keeps them
+replicated for free — and the whole thing still compiles to one program.
+
+Per-step collectives (cf. SURVEY §3.1): 2 all-gathers of the local key batch
+(shuffle-in, unshuffle) + 1 of the 128-d keys (enqueue) + 1 grad psum. The
+reference's rank-0 permutation broadcast and DDP buffer re-broadcast are
+GONE — replaced by deterministic shared-RNG permutation and replicated
+arithmetic (zero communication).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from moco_tpu.config import PretrainConfig
+from moco_tpu.models import build_resnet
+from moco_tpu.ops.ema import ema_update, momentum_schedule
+from moco_tpu.ops.losses import (
+    contrastive_accuracy,
+    infonce_logits,
+    l2_normalize,
+    softmax_cross_entropy,
+)
+from moco_tpu.ops.queue import dequeue_and_enqueue
+from moco_tpu.parallel.collectives import (
+    all_gather_batch,
+    batch_shuffle,
+    batch_unshuffle,
+)
+from moco_tpu.parallel.mesh import DATA_AXIS
+from moco_tpu.train_state import TrainState
+
+
+def build_encoder(config: PretrainConfig):
+    """Encoder factory — the reference's `models.__dict__[arch](num_classes=dim)`
+    plus the v2 MLP-head splice (`moco/builder.py:≈L25-35`). For v3 the
+    encoder is backbone→projector (+predictor on the query side), so this
+    returns the composite `V3Model`."""
+    dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
+    if config.variant == "v3":
+        from moco_tpu.v3_step import V3Model
+
+        if config.arch.startswith("vit"):
+            from moco_tpu.models.vit import build_vit
+
+            backbone = build_vit(config.arch, num_classes=None, dtype=dtype)
+        else:
+            backbone = build_resnet(
+                config.arch,
+                num_classes=None,
+                cifar_stem=config.cifar_stem,
+                dtype=dtype,
+                bn_cross_replica_axis=DATA_AXIS if config.sync_bn else None,
+            )
+        return V3Model(backbone, embed_dim=config.embed_dim)
+    if config.arch.startswith("vit"):
+        from moco_tpu.models.vit import build_vit
+
+        return build_vit(config.arch, num_classes=config.embed_dim, dtype=dtype)
+    return build_resnet(
+        config.arch,
+        num_classes=config.embed_dim,
+        mlp_head=config.mlp_head,
+        cifar_stem=config.cifar_stem,
+        dtype=dtype,
+        bn_cross_replica_axis=DATA_AXIS if config.sync_bn else None,
+    )
+
+
+def lr_schedule(config: PretrainConfig, steps_per_epoch: int) -> Callable:
+    """Step→lr. v1/v2: evaluated at integer epochs (`floor(step/spe)`) to
+    match the reference's per-epoch `adjust_learning_rate`
+    (`main_moco.py:≈L377-388`). v3: FRACTIONAL epoch — the moco-v3 driver
+    adjusts per-iteration (`epoch + i/len(loader)`), and with per-epoch
+    stepping the whole first warmup epoch would run at lr=0."""
+    from moco_tpu.ops.schedules import cosine_lr, step_lr, warmup_cosine_lr
+
+    def sched(step):
+        epoch = jnp.asarray(step, jnp.float32) / steps_per_epoch
+        if config.variant != "v3":
+            epoch = jnp.floor(epoch)
+        if config.warmup_epochs > 0:
+            return warmup_cosine_lr(config.lr, epoch, config.epochs, config.warmup_epochs)
+        if config.cos:
+            return cosine_lr(config.lr, epoch, config.epochs)
+        return step_lr(config.lr, epoch, config.schedule)
+
+    return sched
+
+
+def build_optimizer(
+    config: PretrainConfig, steps_per_epoch: int
+) -> tuple[optax.GradientTransformation, Callable]:
+    """The reference's SGD(momentum=0.9, wd=1e-4) with wd folded into the
+    momentum buffer (torch semantics: wd enters the gradient BEFORE the
+    momentum trace), plus v3's AdamW/LARS options (SURVEY §2.9)."""
+    sched = lr_schedule(config, steps_per_epoch)
+    if config.optimizer == "sgd":
+        tx = optax.chain(
+            optax.add_decayed_weights(config.weight_decay),
+            optax.sgd(sched, momentum=config.sgd_momentum),
+        )
+    elif config.optimizer == "adamw":
+        tx = optax.adamw(sched, weight_decay=config.weight_decay)
+    elif config.optimizer == "lars":
+        tx = optax.lars(
+            sched, weight_decay=config.weight_decay, momentum=config.sgd_momentum
+        )
+    else:
+        raise ValueError(f"unknown optimizer {config.optimizer!r}")
+    if config.variant == "v3" and config.arch.startswith("vit"):
+        # frozen random patch projection: stop_gradient in the model zeroes
+        # the grads; the mask stops weight decay from moving the params too
+        from moco_tpu.v3_step import patch_embed_trainable_mask
+
+        tx = optax.masked(tx, patch_embed_trainable_mask)
+    return tx, sched
+
+
+def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: int, sched=None):
+    """Return jitted `(state, im_q, im_k) -> (state', metrics)`, state donated.
+
+    `im_q`/`im_k` are GLOBAL `[B, H, W, C]` batches (sharded over the data
+    axis by the input pipeline); metrics are replicated scalars.
+
+    `sched` must be the schedule returned by `build_optimizer` for the SAME
+    `steps_per_epoch` — pass it through so the logged `metrics['lr']` is by
+    construction the lr optax applies. If omitted it is re-derived here with
+    this call's `steps_per_epoch`.
+    """
+    if config.variant == "v3":
+        from moco_tpu.v3_step import build_v3_train_step
+
+        return build_v3_train_step(config, model, tx, mesh, steps_per_epoch, sched)
+
+    temperature = config.temperature
+    total_steps = config.epochs * steps_per_epoch
+    if sched is None:
+        sched = lr_schedule(config, steps_per_epoch)
+
+    def spmd_region(params_q, params_k, stats_q, stats_k, queue, im_q, im_k, key):
+        # --- ShuffleBN: decorrelate per-device BN groups on the key path ---
+        im_k_shuf, perm = batch_shuffle(im_k, key, DATA_AXIS)
+        k, mut_k = model.apply(
+            {"params": params_k, "batch_stats": stats_k},
+            im_k_shuf,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        k = l2_normalize(k)
+        k = batch_unshuffle(k, perm, DATA_AXIS)
+        k = lax.stop_gradient(k)  # the reference's no_grad key path
+
+        def loss_fn(pq):
+            q, mut_q = model.apply(
+                {"params": pq, "batch_stats": stats_q},
+                im_q,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            q = l2_normalize(q)
+            logits, labels = infonce_logits(q, k, queue, temperature)
+            return softmax_cross_entropy(logits, labels), (
+                mut_q["batch_stats"],
+                logits,
+                labels,
+            )
+
+        (loss, (new_stats_q, logits, labels)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params_q)
+        # DDP-equivalent gradient all-reduce (mean over the data axis)
+        grads = lax.pmean(grads, DATA_AXIS)
+        # Running BN stats: averaged across devices so replicas stay
+        # bit-identical (replaces DDP broadcast_buffers, SURVEY §2.2 note).
+        new_stats_q = lax.pmean(new_stats_q, DATA_AXIS)
+        new_stats_k = lax.pmean(mut_k["batch_stats"], DATA_AXIS)
+        acc1, acc5 = contrastive_accuracy(logits, labels)
+        metrics = lax.pmean(
+            {"loss": loss, "acc1": acc1, "acc5": acc5}, DATA_AXIS
+        )
+        return grads, k, new_stats_q, new_stats_k, metrics
+
+    region = jax.shard_map(
+        spmd_region,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(P(), P(DATA_AXIS), P(), P(), P()),
+    )
+
+    def train_step(state: TrainState, im_q, im_k):
+        shuffle_key = jax.random.fold_in(state.rng, state.step)
+        if config.momentum_ramp:
+            m = momentum_schedule(config.momentum_ema, state.step, total_steps)
+        else:
+            m = config.momentum_ema
+        # EMA BEFORE the key forward, every step (`moco/builder.py:≈L120-124`)
+        params_k = ema_update(state.params_k, state.params_q, m)
+        grads, k_global, stats_q, stats_k, metrics = region(
+            state.params_q,
+            params_k,
+            state.batch_stats_q,
+            state.batch_stats_k,
+            state.queue,
+            im_q,
+            im_k,
+            shuffle_key,
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params_q)
+        params_q = optax.apply_updates(state.params_q, updates)
+        # enqueue AFTER the logits (`moco/builder.py:≈L160-163`)
+        queue, queue_ptr = dequeue_and_enqueue(
+            state.queue, state.queue_ptr, k_global
+        )
+        metrics = dict(metrics, lr=sched(state.step), queue_ptr=queue_ptr)
+        new_state = state.replace(
+            step=state.step + 1,
+            params_q=params_q,
+            params_k=params_k,
+            batch_stats_q=stats_q,
+            batch_stats_k=stats_k,
+            opt_state=opt_state,
+            queue=queue,
+            queue_ptr=queue_ptr,
+        )
+        return new_state, metrics
+
+    return jax.jit(train_step, donate_argnums=(0,))

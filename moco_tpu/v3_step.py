@@ -1,0 +1,184 @@
+"""MoCo v3 — queue-free, symmetric, large-batch contrastive step
+(BASELINE config 5; SURVEY §2.9 / §3.5, sibling repo `moco-v3`).
+
+Differences from the v1/v2 step (train_step.py), per the reference:
+- No queue, no ShuffleBN. Negatives are the OTHER in-batch samples,
+  all-gathered across the data mesh.
+- Both crops go through BOTH encoders; the loss is symmetric:
+  `ctr(q1, k2) + ctr(q2, k1)`, each scaled by 2·T.
+- The query model adds a 2-layer PREDICTOR on top of the projector; the
+  momentum encoder is backbone+projector only. EMA therefore covers the
+  params_q subtree MINUS the predictor.
+- Momentum ramps 0.99 → 1.0 on a cosine over training.
+- ViT: the patch-projection is frozen at random init — `stop_gradient` in
+  the model (models/vit.py) plus an optimizer mask here so weight decay
+  cannot move the frozen params either (== `requires_grad=False`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from moco_tpu.config import PretrainConfig
+from moco_tpu.models.heads import V3Predictor, V3Projector
+from moco_tpu.ops.ema import ema_update, momentum_schedule
+from moco_tpu.ops.losses import l2_normalize, v3_contrastive_loss
+from moco_tpu.parallel.collectives import all_gather_batch
+from moco_tpu.parallel.mesh import DATA_AXIS
+from moco_tpu.train_state import TrainState
+
+PREDICTOR_KEY = "predictor"
+
+
+class V3Model(nn.Module):
+    """backbone → projector (→ predictor when `predict=True`).
+
+    One module serves both roles: the key encoder applies it with
+    `predict=False` and a params tree lacking the predictor subtree.
+    """
+
+    backbone: nn.Module
+    embed_dim: int = 256
+    hidden_dim: int = 4096
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, predict: bool = False):
+        f = self.backbone(x, train=train)
+        z = V3Projector(self.hidden_dim, self.embed_dim, name="projector")(f, train=train)
+        if predict:
+            z = V3Predictor(self.hidden_dim, self.embed_dim, name=PREDICTOR_KEY)(
+                z, train=train
+            )
+        return z
+
+
+def encoder_subtree(tree):
+    """Drop the predictor subtree — the part of params_q the EMA covers."""
+    return {k: v for k, v in tree.items() if k != PREDICTOR_KEY}
+
+
+def patch_embed_trainable_mask(params) -> Any:
+    """Optimizer mask: False for every leaf under a `patch_embed` module."""
+
+    def is_trainable(path, _leaf):
+        return not any(
+            getattr(entry, "key", None) == "patch_embed" for entry in path
+        )
+
+    return jax.tree_util.tree_map_with_path(is_trainable, params)
+
+
+def create_v3_train_state(
+    rng: jax.Array, model: V3Model, tx: optax.GradientTransformation, input_shape
+) -> TrainState:
+    """Init query model (with predictor); key tree = encoder subtree copy."""
+    init_key, state_key = jax.random.split(rng)
+    variables = model.init(
+        init_key, jnp.zeros(input_shape, jnp.float32), train=False, predict=True
+    )
+    params_q = variables["params"]
+    batch_stats_q = variables.get("batch_stats", {})
+    params_k = jax.tree.map(jnp.copy, encoder_subtree(params_q))
+    batch_stats_k = jax.tree.map(jnp.copy, encoder_subtree(batch_stats_q))
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params_q=params_q,
+        params_k=params_k,
+        batch_stats_q=batch_stats_q,
+        batch_stats_k=batch_stats_k,
+        opt_state=tx.init(params_q),
+        queue=None,
+        queue_ptr=None,
+        rng=state_key,
+    )
+
+
+def build_v3_train_step(
+    config: PretrainConfig, model: V3Model, tx, mesh, steps_per_epoch: int, sched=None
+):
+    """Jitted `(state, x1, x2) -> (state', metrics)`, state donated."""
+    from moco_tpu.train_step import lr_schedule
+
+    temperature = config.temperature
+    total_steps = config.epochs * steps_per_epoch
+    if sched is None:
+        sched = lr_schedule(config, steps_per_epoch)
+
+    def apply(params, stats, x, predict):
+        out, mut = model.apply(
+            {"params": params, "batch_stats": stats},
+            x,
+            train=True,
+            predict=predict,
+            mutable=["batch_stats"],
+        )
+        return l2_normalize(out), mut["batch_stats"]
+
+    def spmd_region(params_q, params_k, stats_q, stats_k, x1, x2):
+        # momentum-encoder keys for both crops (running stats chained through
+        # the two forwards, as two sequential reference forward calls would)
+        k1, stats_k = apply(params_k, stats_k, x1, predict=False)
+        k2, stats_k = apply(params_k, stats_k, x2, predict=False)
+        k1 = lax.stop_gradient(k1)
+        k2 = lax.stop_gradient(k2)
+
+        def loss_fn(pq):
+            q1, s = apply(pq, stats_q, x1, predict=True)
+            q2, s = apply(pq, s, x2, predict=True)
+            loss = v3_contrastive_loss(q1, k2, temperature, DATA_AXIS) + \
+                   v3_contrastive_loss(q2, k1, temperature, DATA_AXIS)
+            return loss, (s, q1)
+
+        (loss, (new_stats_q, q1)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params_q)
+        grads = lax.pmean(grads, DATA_AXIS)
+        new_stats_q = lax.pmean(new_stats_q, DATA_AXIS)
+        new_stats_k = lax.pmean(stats_k, DATA_AXIS)
+        # monitoring: in-batch top-1 for the q1·k2 direction
+        k2_all = all_gather_batch(k2, DATA_AXIS)
+        logits = jnp.einsum("nc,mc->nm", q1, k2_all, preferred_element_type=jnp.float32)
+        labels = jnp.arange(q1.shape[0]) + lax.axis_index(DATA_AXIS) * q1.shape[0]
+        acc1 = 100.0 * jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+        metrics = lax.pmean({"loss": loss, "acc1": acc1}, DATA_AXIS)
+        return grads, new_stats_q, new_stats_k, metrics
+
+    region = jax.shard_map(
+        spmd_region,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P(), P()),
+    )
+
+    def train_step(state: TrainState, x1, x2):
+        if config.momentum_ramp:
+            m = momentum_schedule(config.momentum_ema, state.step, total_steps)
+        else:
+            m = config.momentum_ema
+        params_k = ema_update(state.params_k, encoder_subtree(state.params_q), m)
+        grads, stats_q, stats_k, metrics = region(
+            state.params_q, params_k, state.batch_stats_q, state.batch_stats_k, x1, x2
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params_q)
+        params_q = optax.apply_updates(state.params_q, updates)
+        metrics = dict(metrics, lr=sched(state.step), momentum=m)
+        return (
+            state.replace(
+                step=state.step + 1,
+                params_q=params_q,
+                params_k=params_k,
+                batch_stats_q=stats_q,
+                batch_stats_k=stats_k,
+                opt_state=opt_state,
+            ),
+            metrics,
+        )
+
+    return jax.jit(train_step, donate_argnums=(0,))

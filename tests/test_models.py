@@ -1,0 +1,109 @@
+"""ResNet/head structure tests. Param counts are pinned against torchvision's
+published totals (the reference's backbone source) so the flax rebuild is
+structurally identical: torchvision resnet18/50 with a 1000-way fc have
+11,689,512 / 25,557,032 parameters; swapping fc for a 128-d head changes only
+the fc term (512·128+128 / 2048·128+128)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.models import ResNet18, ResNet50, V3Predictor, V3Projector, build_resnet
+
+
+def _count(tree):
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+@pytest.fixture(scope="module")
+def r18_vars():
+    model = ResNet18(num_classes=128, cifar_stem=True)
+    v = model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)), train=False)
+    return model, v
+
+
+def test_resnet18_param_count_matches_torchvision():
+    # ImageNet stem so the structure matches torchvision exactly
+    v = jax.eval_shape(
+        lambda: ResNet18(num_classes=128).init(
+            jax.random.key(0), jnp.zeros((1, 224, 224, 3)), train=False
+        )
+    )
+    expected = 11_689_512 - (512 * 1000 + 1000) + (512 * 128 + 128)
+    assert _count(v["params"]) == expected
+
+
+def test_resnet50_param_count_matches_torchvision():
+    v = jax.eval_shape(
+        lambda: ResNet50(num_classes=128).init(
+            jax.random.key(0), jnp.zeros((1, 224, 224, 3)), train=False
+        )
+    )
+    expected = 25_557_032 - (2048 * 1000 + 1000) + (2048 * 128 + 128)
+    assert _count(v["params"]) == expected
+
+
+def test_mlp_head_param_count():
+    # v2 head: Linear(2048,2048)+ReLU+Linear(2048,128) replaces Linear(2048,128)
+    plain = jax.eval_shape(
+        lambda: ResNet50(num_classes=128).init(
+            jax.random.key(0), jnp.zeros((1, 224, 224, 3)), train=False
+        )
+    )
+    mlp = jax.eval_shape(
+        lambda: ResNet50(num_classes=128, mlp_head=True).init(
+            jax.random.key(0), jnp.zeros((1, 224, 224, 3)), train=False
+        )
+    )
+    assert _count(mlp["params"]) - _count(plain["params"]) == 2048 * 2048 + 2048
+
+
+def test_forward_shapes_and_feature_mode(r18_vars):
+    model, v = r18_vars
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    out = model.apply(v, x, train=False)
+    assert out.shape == (2, 128)
+    feat_model = ResNet18(num_classes=None, cifar_stem=True)
+    fv = feat_model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)), train=False)
+    feats = feat_model.apply(fv, x, train=False)
+    assert feats.shape == (2, 512)
+
+
+def test_batch_stats_update_in_train_mode(r18_vars):
+    model, v = r18_vars
+    x = jax.random.normal(jax.random.key(2), (4, 32, 32, 3)) * 5 + 3
+    out, mutated = model.apply(v, x, train=True, mutable=["batch_stats"])
+    before = jax.tree.leaves(v["batch_stats"])
+    after = jax.tree.leaves(mutated["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+    # eval mode must NOT touch stats and must be deterministic
+    out1 = model.apply(v, x, train=False)
+    out2 = model.apply(v, x, train=False)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_bfloat16_activations_f32_params():
+    model = ResNet18(num_classes=64, cifar_stem=True, dtype=jnp.bfloat16)
+    v = model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)), train=False)
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(v["params"]))
+    out = model.apply(v, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert out.dtype == jnp.float32  # head math promoted back to f32
+
+
+def test_build_resnet_registry():
+    with pytest.raises(ValueError, match="unknown arch"):
+        build_resnet("resnet1337")
+    m = build_resnet("resnet34", num_classes=10)
+    assert m.stage_sizes == (3, 4, 6, 3)
+
+
+def test_v3_heads_shapes():
+    proj = V3Projector()
+    pv = proj.init(jax.random.key(0), jnp.zeros((2, 384)), train=False)
+    out = proj.apply(pv, jnp.ones((2, 384)), train=False)
+    assert out.shape == (2, 256)
+    pred = V3Predictor()
+    qv = pred.init(jax.random.key(0), jnp.zeros((2, 256)), train=False)
+    out2 = pred.apply(qv, out, train=False)
+    assert out2.shape == (2, 256)
