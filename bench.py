@@ -1,0 +1,113 @@
+"""North-star benchmark: MoCo-v2 ResNet-50 pretrain throughput (imgs/sec/chip).
+
+Runs the REAL training step — on-device two-crop augmentation + both encoder
+forwards + ShuffleBN collectives + InfoNCE + backward + SGD + donated queue
+update — on whatever chips are present (the sandbox exposes one), with the
+full 65536-slot queue and bf16 compute, and compares per-chip throughput
+against the reference's 8xV100 number (BASELINE.md: ~1340 imgs/s global =
+168 imgs/s/GPU, derived from the README's ~53 h / 200 epochs).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_IMGS_PER_SEC_PER_CHIP = 168.0  # 8xV100 MoCo-v2, BASELINE.md
+
+
+def main():
+    from moco_tpu.config import get_preset
+    from moco_tpu.data.augment import two_crops, v2_aug_config
+    from moco_tpu.parallel.mesh import create_mesh
+    from moco_tpu.train_state import create_train_state
+    from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+    mesh = create_mesh(n_chips)
+
+    # per-chip batch 128 (vs the reference's 32/GPU) — TPU MXU wants batch
+    if on_tpu:
+        config = get_preset("imagenet-moco-v2").replace(
+            batch_size=128 * n_chips, dataset="synthetic"
+        )
+        steps, warmup = 20, 10
+    else:  # CPU fallback so the bench is runnable anywhere (tiny proxy)
+        config = get_preset("imagenet-moco-v2").replace(
+            arch="resnet_tiny", cifar_stem=True, compute_dtype="float32",
+            image_size=32, batch_size=8 * n_chips, num_negatives=64 * n_chips,
+            embed_dim=32, dataset="synthetic",
+        )
+        steps, warmup = 5, 2
+
+    model = build_encoder(config)
+    tx, sched = build_optimizer(config, steps_per_epoch=1000)
+    state = create_train_state(
+        jax.random.key(0),
+        model,
+        tx,
+        (config.batch_size // n_chips, config.image_size, config.image_size, 3),
+        config.num_negatives,
+        config.embed_dim,
+    )
+    step_fn = build_train_step(config, model, tx, mesh, 1000, sched)
+
+    aug_cfg = v2_aug_config(config.image_size)
+    # one staged uint8 batch; re-augmented on device every step (two_crops),
+    # representing the steady-state input path with host decode amortized
+    stage = config.image_size + config.image_size // 8
+    rng = np.random.RandomState(0)
+    imgs_u8 = jnp.asarray(
+        rng.randint(0, 256, (config.batch_size, stage, stage, 3), dtype=np.uint8)
+    )
+    data_key = jax.random.key(1)
+
+    def one_step(state, i):
+        im_q, im_k = two_crops(imgs_u8, jax.random.fold_in(data_key, i), aug_cfg)
+        return step_fn(state, im_q, im_k)
+
+    # Timing notes (measured on the sandbox's tunneled v5e):
+    # - `block_until_ready` does NOT reliably synchronize on the experimental
+    #   axon PJRT relay — only a real device→host transfer does, so we sync
+    #   with float(loss).
+    # - the first executions after compile are relay-warmup (~seconds);
+    #   steady state needs a generous warmup, then chained steps with one
+    #   final sync amortize the ~70 ms relay round-trip.
+    for i in range(warmup):
+        state, metrics = one_step(state, i)
+    float(metrics["loss"])
+
+    best = float("inf")
+    for r in range(2):  # best-of-2 rounds to dodge relay noise
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, metrics = one_step(state, (r + 1) * 1000 + i)
+        float(metrics["loss"])
+        best = min(best, (time.perf_counter() - t0) / steps)
+
+    imgs_per_sec = config.batch_size / best
+    per_chip = imgs_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "moco_v2_r50_pretrain_throughput_per_chip"
+                if on_tpu
+                else "moco_v2_tiny_cpu_proxy_throughput_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

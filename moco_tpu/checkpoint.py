@@ -1,0 +1,186 @@
+"""Checkpoint / resume / export (layer L5; SURVEY §5.4).
+
+Reference behavior being reproduced:
+- `save_checkpoint` (`main_moco.py:≈L322-328`): full state every epoch —
+  model (INCLUDING queue + pointer buffers), optimizer, epoch. Here the whole
+  `TrainState` pytree (queue and ptr included) goes through Orbax, so resume
+  is bit-faithful exactly like the reference's `state_dict` round-trip.
+- `--resume` (`main_moco.py:≈L190-205`): restore model+optimizer+step.
+  TPU-idiomatic extra (SURVEY §5.3): `resume="auto"` restores the latest
+  step in the directory, so a preempted TPU VM continues losslessly.
+- `detection/convert-pretrain-to-detectron2.py`: the export path. We export
+  the QUERY ENCODER with torchvision-style parameter names (the layout the
+  reference's checkpoints have under `module.encoder_q.*`) to safetensors /
+  npz, so external harnesses (lincls re-runs, Detectron2 converters) can
+  consume our checkpoints without JAX (SURVEY §2.6 parity deliverable).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from moco_tpu.train_state import TrainState
+
+
+# ---------------------------------------------------------------------------
+# Orbax save/restore
+# ---------------------------------------------------------------------------
+
+
+def checkpoint_manager(directory: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+    )
+
+
+def save_checkpoint(mgr: ocp.CheckpointManager, state: TrainState, step: int) -> None:
+    mgr.save(step, args=ocp.args.StandardSave(state))
+
+
+def restore_checkpoint(
+    mgr: ocp.CheckpointManager, abstract_state: TrainState, step: int | None = None
+) -> TrainState:
+    """Restore `step` (or the latest). `abstract_state` provides the pytree
+    structure/shardings — pass a freshly-created state."""
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError("no checkpoint found to resume from")
+    return mgr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+
+
+def maybe_resume(
+    mgr: ocp.CheckpointManager, state: TrainState, resume: str
+) -> TrainState:
+    """`resume == "auto"`: latest if any (fresh state otherwise);
+    `resume == ""`: fresh; an integer: that step in `mgr`'s directory; a
+    path `<ckpt_dir>/<step>`: that step from that directory (the reference's
+    `--resume <path>` contract, `main_moco.py:≈L190-205`)."""
+    if not resume:
+        return state
+    if resume == "auto":
+        if mgr.latest_step() is None:
+            return state
+        return restore_checkpoint(mgr, state)
+    if resume.isdigit():
+        return restore_checkpoint(mgr, state, int(resume))
+    # path form: .../<ckpt_dir>/<step>
+    path = os.path.normpath(resume)
+    base = os.path.basename(path)
+    if not base.isdigit():
+        raise ValueError(
+            f"--resume expects 'auto', a step number, or a path ending in a "
+            f"step directory; got {resume!r}"
+        )
+    other = checkpoint_manager(os.path.dirname(path))
+    return restore_checkpoint(other, state, int(base))
+
+
+# ---------------------------------------------------------------------------
+# torchvision-name export (the reference checkpoint dialect)
+# ---------------------------------------------------------------------------
+
+
+def _bn_entries(prefix: str, params: dict, stats: dict) -> dict[str, np.ndarray]:
+    out = {
+        f"{prefix}.weight": np.asarray(params["scale"]),
+        f"{prefix}.bias": np.asarray(params["bias"]),
+    }
+    if stats:
+        out[f"{prefix}.running_mean"] = np.asarray(stats["mean"])
+        out[f"{prefix}.running_var"] = np.asarray(stats["var"])
+    return out
+
+
+def _conv_entry(prefix: str, params: dict) -> dict[str, np.ndarray]:
+    # flax [kh, kw, cin, cout] → torch [cout, cin, kh, kw]
+    return {f"{prefix}.weight": np.asarray(params["kernel"]).transpose(3, 2, 0, 1)}
+
+
+def _dense_entries(prefix: str, params: dict) -> dict[str, np.ndarray]:
+    out = {f"{prefix}.weight": np.asarray(params["kernel"]).T}
+    if "bias" in params:
+        out[f"{prefix}.bias"] = np.asarray(params["bias"])
+    return out
+
+
+def resnet_to_torchvision(
+    params: dict, batch_stats: dict, mlp_head: bool = False, prefix: str = ""
+) -> dict[str, np.ndarray]:
+    """Flatten a moco_tpu ResNet param tree to torchvision state_dict names.
+
+    Name map: `layer{i}_{j}` → `layer{i}.{j}`, `downsample_conv/bn` →
+    `downsample.0/1`, v2 MLP head `fc_hidden`/`fc` → `fc.0`/`fc.2` (the
+    reference's `Sequential(Linear, ReLU, Linear)` indices).
+    """
+    stats = batch_stats or {}
+    out: dict[str, np.ndarray] = {}
+    for name, sub in params.items():
+        sub_stats = stats.get(name, {})
+        if name == "conv1":
+            out.update(_conv_entry(prefix + "conv1", sub))
+        elif name == "bn1":
+            out.update(_bn_entries(prefix + "bn1", sub, sub_stats))
+        elif name.startswith("layer"):
+            stage, block = name.split("_")
+            bprefix = f"{prefix}{stage}.{block}"
+            for lname, lsub in sub.items():
+                lstats = sub_stats.get(lname, {})
+                if lname.startswith("conv"):
+                    out.update(_conv_entry(f"{bprefix}.{lname}", lsub))
+                elif lname.startswith("bn"):
+                    out.update(_bn_entries(f"{bprefix}.{lname}", lsub, lstats))
+                elif lname == "downsample_conv":
+                    out.update(_conv_entry(f"{bprefix}.downsample.0", lsub))
+                elif lname == "downsample_bn":
+                    out.update(_bn_entries(f"{bprefix}.downsample.1", lsub, lstats))
+                else:
+                    raise ValueError(f"unexpected block member {name}.{lname}")
+        elif name == "fc_hidden":
+            out.update(_dense_entries(prefix + "fc.0", sub))
+        elif name == "fc":
+            out.update(
+                _dense_entries(prefix + ("fc.2" if mlp_head else "fc"), sub)
+            )
+        else:
+            raise ValueError(f"unexpected top-level module {name}")
+    return out
+
+
+def export_encoder_q(
+    state: TrainState,
+    path: str,
+    mlp_head: bool = False,
+    prefix: str = "module.encoder_q.",
+) -> dict[str, np.ndarray]:
+    """Write the query encoder in the reference's checkpoint dialect
+    (`module.encoder_q.*`, torchvision tensor layouts) as safetensors (or
+    `.npz` if the path says so). Returns the flat dict written."""
+    flat = resnet_to_torchvision(
+        jax.tree.map(np.asarray, state.params_q),
+        jax.tree.map(np.asarray, state.batch_stats_q),
+        mlp_head=mlp_head,
+        prefix=prefix,
+    )
+    if path.endswith(".npz"):
+        np.savez(path, **flat)
+    else:
+        from safetensors.numpy import save_file
+
+        save_file(flat, path)
+    return flat
+
+
+def import_encoder_q(path: str) -> dict[str, np.ndarray]:
+    """Load a flat exported dict back (for the lincls key-surgery path)."""
+    if path.endswith(".npz"):
+        return dict(np.load(path))
+    from safetensors.numpy import load_file
+
+    return load_file(path)

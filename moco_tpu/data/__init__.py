@@ -1,0 +1,27 @@
+from moco_tpu.data.augment import (
+    AugConfig,
+    augment_batch,
+    eval_aug_config,
+    two_crops,
+    v1_aug_config,
+    v2_aug_config,
+)
+from moco_tpu.data.datasets import CIFAR10, ImageFolder, SyntheticDataset, build_dataset
+from moco_tpu.data.loader import Prefetcher, epoch_loader, epoch_permutation, host_shard
+
+__all__ = [
+    "AugConfig",
+    "augment_batch",
+    "eval_aug_config",
+    "two_crops",
+    "v1_aug_config",
+    "v2_aug_config",
+    "CIFAR10",
+    "ImageFolder",
+    "SyntheticDataset",
+    "build_dataset",
+    "Prefetcher",
+    "epoch_loader",
+    "epoch_permutation",
+    "host_shard",
+]

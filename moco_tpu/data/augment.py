@@ -1,0 +1,257 @@
+"""On-device MoCo augmentation stacks (layer L2; rebuild of
+`main_moco.py:≈L216-244` + `moco/loader.py`).
+
+The reference runs PIL transforms in 32 DataLoader worker processes —
+SURVEY §7 ranks that host pipeline the likely wall-clock bottleneck at TPU
+throughput. TPU-first redesign: the host only decodes/stages uint8 images;
+ALL randomized augmentation (random-resized-crop, flip, color jitter,
+grayscale, Gaussian blur, normalize) runs on device as one vmapped, jitted,
+static-shaped program fused by XLA — and `TwoCropsTransform`'s two
+independent draws (`moco/loader.py:≈L8-18`) become two calls with split PRNG
+keys.
+
+Reproduced parameterizations:
+- v1 aug (`main_moco.py:≈L232-244`): RRC(scale 0.2-1) + grayscale p=.2 +
+  jitter(.4,.4,.4,.4) always + hflip.
+- v2 `--aug-plus` (`≈L216-231`, SimCLR-style): RRC + jitter(.4,.4,.4,.1)
+  p=.8 + grayscale p=.2 + blur(sigma U(.1,2)) p=.5 + hflip.
+- Normalize with ImageNet mean/std.
+
+Static-shape tricks: the variable-size crop is `jax.image.scale_and_translate`
+(crop+resize in one fixed-shape bilinear op); blur uses a fixed-width
+separable kernel whose WEIGHTS carry the per-sample sigma.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# numpy (not jnp): module-level device arrays would initialize the JAX
+# backend at import time, breaking late force_cpu_devices() platform selection
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+class AugConfig(NamedTuple):
+    out_size: int = 224
+    min_scale: float = 0.2
+    max_scale: float = 1.0
+    brightness: float = 0.4
+    contrast: float = 0.4
+    saturation: float = 0.4
+    hue: float = 0.4              # v2 uses 0.1
+    jitter_prob: float = 1.0      # v2 uses 0.8
+    grayscale_prob: float = 0.2
+    blur_prob: float = 0.0        # v2 uses 0.5
+    blur_sigma: tuple[float, float] = (0.1, 2.0)
+    flip_prob: float = 0.5
+    deterministic: bool = False   # eval: fixed-aspect center crop, no randomness
+
+
+def v1_aug_config(out_size: int = 224) -> AugConfig:
+    return AugConfig(out_size=out_size)
+
+
+def v2_aug_config(out_size: int = 224) -> AugConfig:
+    return AugConfig(out_size=out_size, hue=0.1, jitter_prob=0.8, blur_prob=0.5)
+
+
+def eval_aug_config(out_size: int = 224) -> AugConfig:
+    """Deterministic eval transform: resize(256/224 ratio) + center crop —
+    approximated as a fixed full-ish center crop; randomness disabled."""
+    return AugConfig(
+        out_size=out_size, min_scale=0.875**2, max_scale=0.875**2,
+        jitter_prob=0.0, grayscale_prob=0.0, blur_prob=0.0, flip_prob=0.0,
+        brightness=0.0, contrast=0.0, saturation=0.0, hue=0.0,
+        deterministic=True,
+    )
+
+
+# --------------------------------------------------------------------------
+# color helpers (single image [H, W, 3], float32 in [0, 1])
+# --------------------------------------------------------------------------
+
+
+def _rgb_to_hsv(rgb):
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = jnp.max(rgb, axis=-1)
+    minc = jnp.min(rgb, axis=-1)
+    v = maxc
+    delta = maxc - minc
+    safe_delta = jnp.where(delta == 0, 1.0, delta)
+    s = jnp.where(maxc == 0, 0.0, delta / jnp.where(maxc == 0, 1.0, maxc))
+    rc = (maxc - r) / safe_delta
+    gc = (maxc - g) / safe_delta
+    bc = (maxc - b) / safe_delta
+    h = jnp.where(
+        maxc == r, bc - gc, jnp.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc)
+    )
+    h = jnp.where(delta == 0, 0.0, h / 6.0) % 1.0
+    return jnp.stack([h, s, v], axis=-1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = jnp.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(jnp.int32) % 6
+
+    def pick(c0, c1, c2, c3, c4, c5):
+        # select chain, NOT jnp.choose: choose lowers to per-element gathers,
+        # which measured ~35x slower than vectorized selects on TPU
+        return jnp.where(
+            i == 0, c0,
+            jnp.where(i == 1, c1,
+                      jnp.where(i == 2, c2,
+                                jnp.where(i == 3, c3, jnp.where(i == 4, c4, c5)))),
+        )
+
+    r = pick(v, q, p, p, t, v)
+    g = pick(t, v, v, q, p, p)
+    b = pick(p, p, t, v, v, q)
+    return jnp.stack([r, g, b], axis=-1)
+
+
+def _color_jitter(img, key, cfg: AugConfig):
+    kb, kc, ks, kh, kp = jax.random.split(key, 5)
+    # torchvision samples each factor from U(max(0,1-x), 1+x)
+    def factor(k, x):
+        return jax.random.uniform(k, (), minval=max(0.0, 1.0 - x), maxval=1.0 + x)
+
+    out = img * factor(kb, cfg.brightness)                      # brightness
+    mean_gray = jnp.mean(_grayscale(out))
+    out = (out - mean_gray) * factor(kc, cfg.contrast) + mean_gray  # contrast
+    gray = _grayscale(out)[..., None]
+    out = (out - gray) * factor(ks, cfg.saturation) + gray      # saturation
+    if cfg.hue > 0:
+        shift = jax.random.uniform(kh, (), minval=-cfg.hue, maxval=cfg.hue)
+        hsv = _rgb_to_hsv(jnp.clip(out, 0.0, 1.0))
+        hsv = hsv.at[..., 0].set((hsv[..., 0] + shift) % 1.0)
+        out = _hsv_to_rgb(hsv)
+    out = jnp.clip(out, 0.0, 1.0)
+    apply = jax.random.uniform(kp, ()) < cfg.jitter_prob
+    return jnp.where(apply, out, img)
+
+
+def _grayscale(img):
+    # ITU-R 601-2 luma, the PIL 'L' conversion torchvision uses
+    return img[..., 0] * 0.299 + img[..., 1] * 0.587 + img[..., 2] * 0.114
+
+
+def _random_grayscale(img, key, cfg: AugConfig):
+    apply = jax.random.uniform(key, ()) < cfg.grayscale_prob
+    gray = jnp.broadcast_to(_grayscale(img)[..., None], img.shape)
+    return jnp.where(apply, gray, img)
+
+
+def _gaussian_blur(img, key, cfg: AugConfig):
+    ksig, kp = jax.random.split(key)
+    sigma = jax.random.uniform(
+        ksig, (), minval=cfg.blur_sigma[0], maxval=cfg.blur_sigma[1]
+    )
+    radius = max(1, int(0.05 * cfg.out_size))  # fixed width; weights carry sigma
+    offs = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    kernel = jnp.exp(-0.5 * (offs / sigma) ** 2)
+    kernel = kernel / jnp.sum(kernel)
+    # Separable blur as weighted shifted-adds over STATIC slices. Two designs
+    # were measured and rejected on the v5e: slice-stack + einsum fuses the
+    # whole upstream jitter chain into every tap (~20x recompute), and a
+    # grouped `conv_general_dilated` autotunes nondeterministically (12 ms or
+    # 180 ms depending on compilation). Shifted-adds behind an
+    # optimization_barrier are deterministic ALU/bandwidth work.
+    img_b = jax.lax.optimization_barrier(img)
+
+    def conv1d(x, axis):
+        pad = [(0, 0)] * 3
+        pad[axis] = (radius, radius)
+        padded = jnp.pad(x, pad, mode="edge")
+        acc = jnp.zeros_like(x)
+        n = x.shape[axis]
+        for i in range(2 * radius + 1):
+            sl = [slice(None)] * 3
+            sl[axis] = slice(i, i + n)
+            acc = acc + kernel[i] * padded[tuple(sl)]
+        return acc
+
+    blurred = conv1d(conv1d(img_b, 0), 1)
+    apply = jax.random.uniform(kp, ()) < cfg.blur_prob
+    return jnp.where(apply, blurred, img)
+
+
+def _random_resized_crop(img, key, cfg: AugConfig):
+    """torchvision RandomResizedCrop semantics (scale=(s0,s1), ratio 3/4..4/3)
+    as a single fixed-shape `scale_and_translate` (crop+bilinear resize)."""
+    h, w = img.shape[0], img.shape[1]
+    karea, kaspect, ky, kx = jax.random.split(key, 4)
+    area = h * w * jax.random.uniform(
+        karea, (), minval=cfg.min_scale, maxval=cfg.max_scale
+    )
+    if cfg.deterministic:
+        ratio = jnp.asarray(1.0)
+    else:
+        log_ratio = jax.random.uniform(
+            kaspect, (), minval=jnp.log(3.0 / 4.0), maxval=jnp.log(4.0 / 3.0)
+        )
+        ratio = jnp.exp(log_ratio)
+    cw = jnp.clip(jnp.sqrt(area * ratio), 1.0, w)
+    ch = jnp.clip(jnp.sqrt(area / ratio), 1.0, h)
+    if cfg.deterministic:
+        y0, x0 = (h - ch) / 2.0, (w - cw) / 2.0  # center crop
+    else:
+        y0 = jax.random.uniform(ky, (), minval=0.0, maxval=1.0) * (h - ch)
+        x0 = jax.random.uniform(kx, (), minval=0.0, maxval=1.0) * (w - cw)
+    s = cfg.out_size
+    scale = jnp.array([s / ch, s / cw])
+    translation = jnp.array([-y0 * s / ch, -x0 * s / cw])
+    return jax.image.scale_and_translate(
+        img,
+        (s, s, img.shape[2]),
+        (0, 1),
+        scale,
+        translation,
+        method="linear",
+        antialias=True,
+    )
+
+
+def _random_flip(img, key, cfg: AugConfig):
+    apply = jax.random.uniform(key, ()) < cfg.flip_prob
+    return jnp.where(apply, img[:, ::-1, :], img)
+
+
+def _augment_one(img_u8, key, cfg: AugConfig):
+    img = img_u8.astype(jnp.float32) / 255.0
+    kcrop, kjit, kgray, kblur, kflip = jax.random.split(key, 5)
+    img = _random_resized_crop(img, kcrop, cfg)
+    if cfg.jitter_prob > 0:
+        img = _color_jitter(img, kjit, cfg)
+    if cfg.grayscale_prob > 0:
+        img = _random_grayscale(img, kgray, cfg)
+    if cfg.blur_prob > 0:
+        img = _gaussian_blur(img, kblur, cfg)
+    img = _random_flip(img, kflip, cfg)
+    return (img - IMAGENET_MEAN) / IMAGENET_STD
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def augment_batch(images_u8: jax.Array, key: jax.Array, cfg: AugConfig) -> jax.Array:
+    """`[B, H, W, 3] uint8 → [B, S, S, 3] float32` — one independent random
+    draw per sample (vmapped keys)."""
+    keys = jax.random.split(key, images_u8.shape[0])
+    return jax.vmap(_augment_one, in_axes=(0, 0, None))(images_u8, keys, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def two_crops(images_u8: jax.Array, key: jax.Array, cfg: AugConfig):
+    """The `TwoCropsTransform`: two INDEPENDENT draws of the same pipeline
+    (`moco/loader.py:≈L8-18`) → `(im_q, im_k)`."""
+    kq, kk = jax.random.split(key)
+    return augment_batch(images_u8, kq, cfg), augment_batch(images_u8, kk, cfg)

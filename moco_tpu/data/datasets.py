@@ -1,0 +1,155 @@
+"""Datasets (layer L2). The reference uses `torchvision.datasets.ImageFolder`
+(+ CIFAR-10 for the smoke config); equivalents here, torch-free:
+
+- `SyntheticDataset` — class-structured random images, for tests/benches and
+  environments with no data mounted (each class = a fixed low-frequency
+  pattern + per-sample noise, so contrastive learning has real signal and
+  kNN can beat chance; BASELINE config-1 success criterion).
+- `CIFAR10` — reads the standard `cifar-10-batches-py` pickle layout from
+  disk (no network, no torch).
+- `ImageFolder` — class-per-subdirectory JPEG tree, PIL-decoded on host by a
+  thread pool into fixed-size uint8 staging arrays; all randomized cropping
+  happens later on device (data/augment.py).
+
+All datasets expose `images_u8()`-style batched access returning
+`[B, H, W, 3] uint8` + int labels; the host never does float math.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class SyntheticDataset:
+    """Deterministic clusterable fake data in memory."""
+
+    def __init__(
+        self,
+        num_samples: int = 2048,
+        image_size: int = 32,
+        num_classes: int = 10,
+        seed: int = 0,
+        noise: float = 0.15,
+    ):
+        rng = np.random.RandomState(seed)
+        self.num_classes = num_classes
+        self.image_size = image_size
+        # low-frequency class prototypes: random 4x4 upsampled to full size
+        protos = rng.rand(num_classes, 4, 4, 3)
+        reps = image_size // 4
+        protos = protos.repeat(reps, axis=1).repeat(reps, axis=2)
+        labels = rng.randint(0, num_classes, size=num_samples)
+        imgs = protos[labels] + noise * rng.randn(num_samples, image_size, image_size, 3)
+        self.images = (np.clip(imgs, 0, 1) * 255).astype(np.uint8)
+        self.labels = labels.astype(np.int32)
+
+    def __len__(self):
+        return len(self.images)
+
+    def get_batch(self, indices: np.ndarray):
+        return self.images[indices], self.labels[indices]
+
+
+class CIFAR10:
+    """`cifar-10-batches-py` reader (binary pickle layout, 50k train / 10k test)."""
+
+    def __init__(self, data_dir: str, train: bool = True):
+        batch_dir = data_dir
+        if os.path.isdir(os.path.join(data_dir, "cifar-10-batches-py")):
+            batch_dir = os.path.join(data_dir, "cifar-10-batches-py")
+        names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+        xs, ys = [], []
+        for n in names:
+            path = os.path.join(batch_dir, n)
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"CIFAR-10 batch {path} not found — place the "
+                    "'cifar-10-batches-py' directory under data_dir"
+                )
+            with open(path, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.extend(d[b"labels"])
+        x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        self.images = np.ascontiguousarray(x)
+        self.labels = np.asarray(ys, np.int32)
+        self.num_classes = 10
+        self.image_size = 32
+
+    def __len__(self):
+        return len(self.images)
+
+    def get_batch(self, indices: np.ndarray):
+        return self.images[indices], self.labels[indices]
+
+
+@dataclass
+class _ImageEntry:
+    path: str
+    label: int
+
+
+class ImageFolder:
+    """Class-per-subdir image tree; decodes to a fixed `stage_size` square
+    uint8 staging array on the host (shorter-side resize + center crop —
+    the final random crop happens on device with full scale range)."""
+
+    def __init__(self, root: str, stage_size: int = 256, num_workers: int = 8):
+        from PIL import Image  # lazy: torch-free PIL dependency
+
+        self._Image = Image
+        self.stage_size = stage_size
+        self.image_size = stage_size
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        if not classes:
+            raise FileNotFoundError(f"no class subdirectories under {root!r}")
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.num_classes = len(classes)
+        self.entries: list[_ImageEntry] = []
+        exts = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if os.path.splitext(fname)[1].lower() in exts:
+                    self.entries.append(
+                        _ImageEntry(os.path.join(cdir, fname), self.class_to_idx[c])
+                    )
+        self.labels = np.asarray([e.label for e in self.entries], np.int32)
+        self._pool = ThreadPoolExecutor(max_workers=num_workers)
+
+    def __len__(self):
+        return len(self.entries)
+
+    def _load_one(self, idx: int) -> np.ndarray:
+        img = self._Image.open(self.entries[idx].path).convert("RGB")
+        w, h = img.size
+        s = self.stage_size
+        scale = s / min(w, h)
+        img = img.resize((max(s, round(w * scale)), max(s, round(h * scale))))
+        w, h = img.size
+        left, top = (w - s) // 2, (h - s) // 2
+        img = img.crop((left, top, left + s, top + s))
+        return np.asarray(img, np.uint8)
+
+    def get_batch(self, indices: np.ndarray):
+        imgs = list(self._pool.map(self._load_one, [int(i) for i in indices]))
+        return np.stack(imgs), self.labels[indices]
+
+
+def build_dataset(name: str, data_dir: str = "", image_size: int = 32, **kw):
+    if name == "synthetic":
+        return SyntheticDataset(image_size=image_size, **kw)
+    if name == "cifar10":
+        return CIFAR10(data_dir, **kw)
+    if name == "imagefolder":
+        sub = os.path.join(data_dir, "train")
+        root = sub if os.path.isdir(sub) else data_dir
+        return ImageFolder(root, **kw)
+    raise ValueError(f"unknown dataset {name!r}")

@@ -1,0 +1,115 @@
+"""Host→device input pipeline (rebuild of `DataLoader` + `DistributedSampler`
+usage in `main_moco.py:≈L228-278`).
+
+- `epoch_permutation` replaces `DistributedSampler.set_epoch`: a
+  deterministic per-epoch shuffle of the whole dataset, seeded identically on
+  every host; each host then takes its contiguous shard (`process_index`), so
+  shards are disjoint and exhaustive — the same guarantee the reference gets
+  from `DistributedSampler`.
+- `Prefetcher` double-buffers: a background thread stages the NEXT batch
+  (host decode) while the device runs the current step, then `device_put`s
+  with the batch sharding so each chip receives only its slice. This replaces
+  the reference's worker processes + `pin_memory` H2D overlap.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from moco_tpu.parallel.mesh import DATA_AXIS
+
+
+def epoch_permutation(n: int, epoch: int, seed: int, global_batch: int) -> np.ndarray:
+    """Deterministic epoch shuffle, truncated to whole batches (the
+    reference's `drop_last=True`)."""
+    rng = np.random.RandomState((seed * 100003 + epoch) % (2**31))
+    perm = rng.permutation(n)
+    usable = (n // global_batch) * global_batch
+    return perm[:usable]
+
+
+def host_shard(indices: np.ndarray, global_batch: int) -> np.ndarray:
+    """This host's slice of every global batch (multi-host data sharding)."""
+    nproc = jax.process_count()
+    if nproc == 1:
+        return indices
+    if global_batch % nproc != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by process count {nproc}"
+        )
+    pid = jax.process_index()
+    per_host = global_batch // nproc
+    batches = indices.reshape(-1, global_batch)
+    return batches[:, pid * per_host : (pid + 1) * per_host].reshape(-1)
+
+
+class Prefetcher:
+    """Iterate `(images_u8, labels)` device-sharded batches with background
+    host staging."""
+
+    def __init__(self, dataset, indices: np.ndarray, batch_per_host: int, mesh: Mesh, depth: int = 2):
+        self.dataset = dataset
+        self.indices = indices
+        self.batch = batch_per_host
+        self.sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self.label_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self.num_batches = len(indices) // batch_per_host
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        for b in range(self.num_batches):
+            item = self.dataset.get_batch(
+                self.indices[b * self.batch : (b + 1) * self.batch]
+            )
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if self._stop.is_set():
+                return
+        self._q.put(None)
+
+    def close(self):
+        """Unblock and join the staging thread (consumers that break out of
+        the iterator early MUST call this or the thread + `depth` staged
+        batches leak for the life of the process)."""
+        self._stop.set()
+        while not self._q.empty():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+
+    def __iter__(self) -> Iterator:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            imgs, labels = item
+            yield (
+                jax.device_put(imgs, self.sharding),
+                jax.device_put(labels, self.label_sharding),
+            )
+
+    def __len__(self):
+        return self.num_batches
+
+
+def epoch_loader(dataset, epoch: int, seed: int, global_batch: int, mesh: Mesh) -> Prefetcher:
+    """One epoch of sharded batches (sampler.set_epoch + DataLoader in one)."""
+    perm = epoch_permutation(len(dataset), epoch, seed, global_batch)
+    local = host_shard(perm, global_batch)
+    per_host = global_batch // jax.process_count()
+    return Prefetcher(dataset, local, per_host, mesh)

@@ -168,15 +168,26 @@ ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
 ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck)
 ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=Bottleneck)
 
+# 2-stage, width-16 micro-ResNet: smoke tests / CI on the single-core CPU
+# sandbox, where a full ResNet-18 compile is minutes. Not a reference arch.
+ResNetTiny = partial(ResNet, stage_sizes=(1, 1), block_cls=BasicBlock, width=16)
+
 # `--arch` registry (the reference's `model_names`/`models.__dict__[arch]`).
 ARCHS: dict[str, Callable[..., ResNet]] = {
     "resnet18": ResNet18,
     "resnet34": ResNet34,
     "resnet50": ResNet50,
     "resnet101": ResNet101,
+    "resnet_tiny": ResNetTiny,
 }
 
-FEATURE_DIMS = {"resnet18": 512, "resnet34": 512, "resnet50": 2048, "resnet101": 2048}
+FEATURE_DIMS = {
+    "resnet18": 512,
+    "resnet34": 512,
+    "resnet50": 2048,
+    "resnet101": 2048,
+    "resnet_tiny": 32,
+}
 
 
 def build_resnet(arch: str, **kwargs) -> ResNet:

@@ -1,0 +1,267 @@
+"""Pretrain driver (layer L4; rebuild of `main_moco.py`).
+
+Control flow parity with `main_moco.py:≈L114-320` — argparse → build model/
+optimizer/data → epoch loop → per-step train → meters → rank-0 checkpoint —
+minus the process fan-out: there is no `mp.spawn`, no per-GPU worker; ONE
+controller process per host drives all local chips through the jitted SPMD
+step (SURVEY §2.10 process-topology row).
+
+Usage:
+    python -m moco_tpu.train --preset cifar10-moco-v1 --data-dir /data/cifar
+    python -m moco_tpu.train --preset imagenet-moco-v2 --data-dir /data/imagenet
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moco_tpu.checkpoint import checkpoint_manager, maybe_resume, save_checkpoint
+from moco_tpu.config import PRESETS, PretrainConfig, get_preset
+from moco_tpu.data import (
+    build_dataset,
+    epoch_loader,
+    two_crops,
+    v1_aug_config,
+    v2_aug_config,
+)
+from moco_tpu.ops.knn import knn_accuracy
+from moco_tpu.parallel.mesh import create_mesh, local_batch_size
+from moco_tpu.train_state import create_train_state
+from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
+from moco_tpu.utils.meters import AverageMeter, ProgressMeter, Throughput
+
+
+def make_feature_fn(model, variant: str):
+    """Jitted frozen-encoder embedding fn for the kNN monitor (eval-mode BN)."""
+
+    @jax.jit
+    def feature_fn(params, batch_stats, images_f32):
+        kwargs = {"predict": False} if variant == "v3" else {}
+        out = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images_f32,
+            train=False,
+            **kwargs,
+        )
+        return out / jnp.linalg.norm(out, axis=-1, keepdims=True)
+
+    return feature_fn
+
+
+def knn_monitor(config, feature_fn, state, dataset, max_bank: int = 4096) -> float:
+    """Periodic kNN top-1 on held-out-ish data (SURVEY §2.5 protocol at
+    monitoring scale: embed a train subset as the bank, score a val subset).
+    `feature_fn` comes from `make_feature_fn` ONCE per run (recompiling the
+    eval forward every epoch costs minutes on the sandbox)."""
+    from moco_tpu.data.augment import eval_aug_config, augment_batch
+
+    cfg = eval_aug_config(config.image_size)
+    n = min(len(dataset), max_bank)
+    split = int(n * 0.8)
+    rng = np.random.RandomState(config.seed)
+    idx = rng.permutation(len(dataset))[:n]
+    key = jax.random.key(config.seed)
+
+    def embed(indices):
+        feats, labels = [], []
+        for start in range(0, len(indices), 256):
+            chunk = indices[start : start + 256]
+            imgs, lbls = dataset.get_batch(chunk)
+            valid = len(chunk)
+            if valid < 256:  # pad the tail so shapes (and compiles) are fixed
+                imgs = np.concatenate([imgs, np.repeat(imgs[-1:], 256 - valid, 0)])
+            imgs_f32 = augment_batch(jnp.asarray(imgs), key, cfg)
+            out = np.asarray(
+                feature_fn(state.params_q, state.batch_stats_q, imgs_f32)
+            )
+            feats.append(out[:valid])
+            labels.append(lbls)
+        return np.concatenate(feats), np.concatenate(labels)
+
+    bank, bank_labels = embed(idx[:split])
+    val, val_labels = embed(idx[split:])
+    return knn_accuracy(
+        jnp.asarray(val), jnp.asarray(val_labels), jnp.asarray(bank),
+        jnp.asarray(bank_labels), num_classes=dataset.num_classes,
+        k=min(200, split), temperature=0.07,
+    )
+
+
+def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
+    """Run pretraining; returns (final_state, last_metrics_dict)."""
+    if mesh is None:
+        mesh = create_mesh()
+    n_chips = mesh.size
+    local_b = local_batch_size(config.batch_size, mesh)  # validates divisibility
+
+    dataset = build_dataset(
+        config.dataset, config.data_dir, image_size=config.image_size
+    )
+    steps_per_epoch = config.steps_per_epoch or max(
+        len(dataset) // config.batch_size, 1
+    )
+
+    model = build_encoder(config)
+    tx, sched = build_optimizer(config, steps_per_epoch)
+    init_key = jax.random.key(config.seed)
+    if config.variant == "v3":
+        from moco_tpu.v3_step import create_v3_train_state
+
+        state = create_v3_train_state(
+            init_key, model, tx, (local_b, config.image_size, config.image_size, 3)
+        )
+    else:
+        state = create_train_state(
+            init_key,
+            model,
+            tx,
+            (local_b, config.image_size, config.image_size, 3),
+            config.num_negatives,
+            config.embed_dim,
+        )
+    step_fn = build_train_step(config, model, tx, mesh, steps_per_epoch, sched)
+
+    mgr = checkpoint_manager(config.ckpt_dir) if config.ckpt_dir else None
+    if mgr is not None and config.resume:
+        state = maybe_resume(mgr, state, config.resume)
+        # Orbax restores onto the default device; re-place as replicated
+        # across the mesh so the SPMD step sees consistent shardings
+        from moco_tpu.parallel.mesh import replicated
+
+        state = jax.device_put(state, replicated(mesh))
+
+    aug_cfg = (
+        v2_aug_config(config.image_size)
+        if config.aug_plus
+        else v1_aug_config(config.image_size)
+    )
+    data_key = jax.random.key(config.seed + 1)
+
+    # host-side step counter mirroring state.step: int(state.step) would be a
+    # device→host sync (~70 ms on the relay) serializing every iteration
+    global_step = int(state.step)
+    start_epoch = global_step // steps_per_epoch
+    total_steps = max_steps or config.epochs * steps_per_epoch
+    last_metrics: dict = {}
+    feature_fn = make_feature_fn(model, config.variant) if config.knn_monitor else None
+    done = False
+
+    for epoch in range(start_epoch, config.epochs):
+        if done:
+            break
+        batch_time = AverageMeter("Time", ":6.3f")
+        data_time = AverageMeter("Data", ":6.3f")
+        losses = AverageMeter("Loss", ":.4e")
+        top1 = AverageMeter("Acc@1", ":6.2f")
+        top5 = AverageMeter("Acc@5", ":6.2f")
+        progress = ProgressMeter(
+            steps_per_epoch,
+            [batch_time, data_time, losses, top1, top5],
+            prefix=f"Epoch: [{epoch}]",
+        )
+        throughput = Throughput(n_chips)
+        loader = epoch_loader(dataset, epoch, config.seed, config.batch_size, mesh)
+        end = time.perf_counter()
+        try:
+            for i, (imgs, _labels) in enumerate(loader):
+                if i >= steps_per_epoch:  # steps_per_epoch may cap the epoch
+                    break
+                data_time.update(time.perf_counter() - end)
+                step_key = jax.random.fold_in(data_key, global_step)
+                im_q, im_k = two_crops(imgs, step_key, aug_cfg)
+                state, metrics = step_fn(state, im_q, im_k)
+                global_step += 1
+                if i % config.print_freq == 0:
+                    # pull metrics (host sync) only when printing
+                    last_metrics = {k: float(v) for k, v in metrics.items()}
+                    losses.update(last_metrics["loss"], config.batch_size)
+                    top1.update(last_metrics.get("acc1", 0.0), config.batch_size)
+                    top5.update(last_metrics.get("acc5", 0.0), config.batch_size)
+                    progress.display(i)
+                throughput.update(config.batch_size)
+                batch_time.update(time.perf_counter() - end)
+                end = time.perf_counter()
+                if global_step >= total_steps:
+                    done = True
+                    break
+        finally:
+            loader.close()  # unblock the prefetch thread on early break
+        print(
+            f"Epoch [{epoch}] imgs/sec {throughput.imgs_per_sec:.1f} "
+            f"({throughput.imgs_per_sec_per_chip:.1f}/chip)",
+            flush=True,
+        )
+        if config.knn_monitor:
+            acc = knn_monitor(config, feature_fn, state, dataset)
+            last_metrics["knn_top1"] = acc
+            print(f"Epoch [{epoch}] kNN top-1 {100 * acc:.2f}%", flush=True)
+        if mgr is not None and (epoch + 1) % config.ckpt_every_epochs == 0:
+            # unlike the reference's rank-0-only torch.save, Orbax saving of
+            # multi-process arrays is COLLECTIVE — every process must call it
+            save_checkpoint(mgr, state, global_step)
+    if mgr is not None:
+        mgr.wait_until_finished()
+    return state, last_metrics
+
+
+def _add_config_flags(parser: argparse.ArgumentParser):
+    """Reference-style flag surface; every dataclass field is a `--flag`."""
+    for f in dataclasses.fields(PretrainConfig):
+        name = "--" + f.name.replace("_", "-")
+        if f.type == "bool" or isinstance(f.default, bool):
+            parser.add_argument(name, type=lambda s: s.lower() in ("1", "true", "yes"),
+                                default=None)
+        elif f.name == "schedule":
+            parser.add_argument(name, type=int, nargs="*", default=None)
+        elif isinstance(f.default, (int, float, str)) or f.default is None:
+            # fields defaulting to None: int-typed ones listed explicitly
+            caster = (
+                int
+                if f.name in ("steps_per_epoch",)
+                else type(f.default)
+                if f.default is not None
+                else str
+            )
+            parser.add_argument(name, type=caster, default=None)
+    return parser
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="moco_tpu pretraining")
+    pretrain_presets = sorted(
+        name for name, cfg in PRESETS.items() if isinstance(cfg, PretrainConfig)
+    )
+    parser.add_argument("--preset", default="cifar10-moco-v1", choices=pretrain_presets)
+    parser.add_argument("--max-steps", type=int, default=None)
+    parser.add_argument("--num-devices", type=int, default=None)
+    parser.add_argument("--fake-devices", type=int, default=0,
+                        help="force N fake CPU devices (testing)")
+    _add_config_flags(parser)
+    args = parser.parse_args(argv)
+    if args.fake_devices:
+        from moco_tpu.parallel.mesh import force_cpu_devices
+
+        force_cpu_devices(args.fake_devices)
+    config = get_preset(args.preset)
+    overrides = {
+        f.name: getattr(args, f.name)
+        for f in dataclasses.fields(PretrainConfig)
+        if getattr(args, f.name, None) is not None
+    }
+    if "schedule" in overrides:
+        overrides["schedule"] = tuple(overrides["schedule"])
+    config = config.replace(**overrides)
+    mesh = create_mesh(args.num_devices)
+    print(f"config: {config}")
+    print(f"mesh: {mesh}")
+    train(config, mesh, max_steps=args.max_steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Console metering (rebuild of `AverageMeter`/`ProgressMeter`,
+`main_moco.py:≈L330-375`) plus the imgs/sec meter that IS the north-star
+throughput metric (BASELINE.md derived-throughput row)."""
+
+from __future__ import annotations
+
+import time
+
+
+class AverageMeter:
+    """Running value/average, printed as `name val (avg)`."""
+
+    def __init__(self, name: str, fmt: str = ":f"):
+        self.name, self.fmt = name, fmt
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1):
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+
+    @property
+    def avg(self) -> float:
+        return self.sum / max(self.count, 1)
+
+    def __str__(self):
+        return ("{name} {val" + self.fmt + "} ({avg" + self.fmt + "})").format(
+            name=self.name, val=self.val, avg=self.avg
+        )
+
+
+class ProgressMeter:
+    def __init__(self, num_batches: int, meters, prefix: str = ""):
+        fmt = "{:" + str(len(str(num_batches))) + "d}"
+        self.batch_fmtstr = "[" + fmt + "/" + fmt.format(num_batches) + "]"
+        self.meters = meters
+        self.prefix = prefix
+
+    def display(self, batch: int):
+        entries = [self.prefix + self.batch_fmtstr.format(batch)]
+        entries += [str(m) for m in self.meters]
+        print("\t".join(entries), flush=True)
+
+
+class Throughput:
+    """imgs/sec (global and per-chip) over a rolling window."""
+
+    def __init__(self, num_chips: int):
+        self.num_chips = num_chips
+        self.reset()
+
+    def reset(self):
+        self._t0 = time.perf_counter()
+        self._images = 0
+
+    def update(self, n_images: int):
+        self._images += n_images
+
+    @property
+    def imgs_per_sec(self) -> float:
+        dt = time.perf_counter() - self._t0
+        return self._images / dt if dt > 0 else 0.0
+
+    @property
+    def imgs_per_sec_per_chip(self) -> float:
+        return self.imgs_per_sec / max(self.num_chips, 1)

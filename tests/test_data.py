@@ -1,0 +1,111 @@
+"""Data layer tests: on-device augmentation, datasets, loader."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.data import (
+    SyntheticDataset,
+    augment_batch,
+    epoch_loader,
+    epoch_permutation,
+    eval_aug_config,
+    host_shard,
+    two_crops,
+    v1_aug_config,
+    v2_aug_config,
+)
+from moco_tpu.data.augment import _hsv_to_rgb, _rgb_to_hsv
+
+
+@pytest.fixture(scope="module")
+def batch_u8():
+    rng = np.random.RandomState(0)
+    return jnp.asarray(rng.randint(0, 256, (4, 32, 32, 3), dtype=np.uint8))
+
+
+def test_augment_shapes_and_dtype(batch_u8):
+    cfg = v1_aug_config(out_size=16)
+    out = augment_batch(batch_u8, jax.random.key(0), cfg)
+    assert out.shape == (4, 16, 16, 3)
+    assert out.dtype == jnp.float32
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_two_crops_independent(batch_u8):
+    cfg = v2_aug_config(out_size=16)
+    q, k = two_crops(batch_u8, jax.random.key(1), cfg)
+    assert q.shape == k.shape == (4, 16, 16, 3)
+    assert not np.allclose(np.asarray(q), np.asarray(k))
+
+
+def test_augment_deterministic_per_key(batch_u8):
+    cfg = v2_aug_config(out_size=16)
+    a = augment_batch(batch_u8, jax.random.key(2), cfg)
+    b = augment_batch(batch_u8, jax.random.key(2), cfg)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = augment_batch(batch_u8, jax.random.key(3), cfg)
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+
+
+def test_per_sample_randomness(batch_u8):
+    """Identical images in a batch must receive DIFFERENT crops."""
+    same = jnp.broadcast_to(batch_u8[:1], batch_u8.shape)
+    cfg = v1_aug_config(out_size=16)
+    out = np.asarray(augment_batch(same, jax.random.key(4), cfg))
+    assert not np.allclose(out[0], out[1])
+
+
+def test_eval_aug_deterministic(batch_u8):
+    cfg = eval_aug_config(out_size=16)
+    a = augment_batch(batch_u8, jax.random.key(5), cfg)
+    b = augment_batch(batch_u8, jax.random.key(6), cfg)  # different keys!
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_hsv_roundtrip():
+    rgb = jnp.asarray(np.random.RandomState(1).rand(8, 8, 3).astype(np.float32))
+    back = _hsv_to_rgb(_rgb_to_hsv(rgb))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(rgb), atol=1e-5)
+
+
+def test_synthetic_dataset_clusterable():
+    ds = SyntheticDataset(num_samples=64, image_size=16, num_classes=4, seed=1)
+    imgs, labels = ds.get_batch(np.arange(64))
+    assert imgs.shape == (64, 16, 16, 3) and imgs.dtype == np.uint8
+    # same-class images more similar than cross-class on average
+    f = imgs.reshape(64, -1).astype(np.float32)
+    same, diff = [], []
+    for i in range(0, 32):
+        for j in range(i + 1, 32):
+            d = np.linalg.norm(f[i] - f[j])
+            (same if labels[i] == labels[j] else diff).append(d)
+    assert np.mean(same) < np.mean(diff)
+
+
+def test_epoch_permutation_drops_last():
+    p = epoch_permutation(103, epoch=0, seed=0, global_batch=10)
+    assert len(p) == 100
+    assert len(set(p.tolist())) == 100
+    p2 = epoch_permutation(103, epoch=1, seed=0, global_batch=10)
+    assert not np.array_equal(p, p2)  # set_epoch reshuffles
+    p3 = epoch_permutation(103, epoch=0, seed=0, global_batch=10)
+    np.testing.assert_array_equal(p, p3)  # deterministic
+
+
+def test_host_shard_single_process_identity():
+    idx = np.arange(40)
+    np.testing.assert_array_equal(host_shard(idx, 8), idx)
+
+
+def test_epoch_loader_yields_sharded_batches(mesh8):
+    ds = SyntheticDataset(num_samples=70, image_size=16, num_classes=3)
+    loader = epoch_loader(ds, epoch=0, seed=0, global_batch=16, mesh=mesh8)
+    batches = list(loader)
+    assert len(batches) == len(loader) == 70 // 16
+    imgs, labels = batches[0]
+    assert imgs.shape == (16, 16, 16, 3)
+    assert labels.shape == (16,)
+    # sharded over the 8 devices, 2 rows each
+    assert len(imgs.sharding.device_set) == 8
