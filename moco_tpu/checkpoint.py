@@ -39,8 +39,17 @@ def checkpoint_manager(directory: str, max_to_keep: int = 3) -> ocp.CheckpointMa
     )
 
 
+def _unkey(state: TrainState) -> TrainState:
+    """Typed PRNG keys are not serializable; store the raw uint32 key data."""
+    return state.replace(rng=jax.random.key_data(state.rng))
+
+
+def _rekey(state: TrainState) -> TrainState:
+    return state.replace(rng=jax.random.wrap_key_data(state.rng))
+
+
 def save_checkpoint(mgr: ocp.CheckpointManager, state: TrainState, step: int) -> None:
-    mgr.save(step, args=ocp.args.StandardSave(state))
+    mgr.save(step, args=ocp.args.StandardSave(_unkey(state)))
 
 
 def restore_checkpoint(
@@ -52,7 +61,8 @@ def restore_checkpoint(
         step = mgr.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint found to resume from")
-    return mgr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+    restored = mgr.restore(step, args=ocp.args.StandardRestore(_unkey(abstract_state)))
+    return _rekey(restored)
 
 
 def maybe_resume(
@@ -99,26 +109,34 @@ def _bn_entries(prefix: str, params: dict, stats: dict) -> dict[str, np.ndarray]
 
 
 def _conv_entry(prefix: str, params: dict) -> dict[str, np.ndarray]:
-    # flax [kh, kw, cin, cout] → torch [cout, cin, kh, kw]
-    return {f"{prefix}.weight": np.asarray(params["kernel"]).transpose(3, 2, 0, 1)}
+    # flax [kh, kw, cin, cout] → torch [cout, cin, kh, kw]. Contiguous copy:
+    # safetensors serializes the raw buffer and ignores view strides.
+    return {
+        f"{prefix}.weight": np.ascontiguousarray(
+            np.asarray(params["kernel"]).transpose(3, 2, 0, 1)
+        )
+    }
 
 
 def _dense_entries(prefix: str, params: dict) -> dict[str, np.ndarray]:
-    out = {f"{prefix}.weight": np.asarray(params["kernel"]).T}
+    out = {f"{prefix}.weight": np.ascontiguousarray(np.asarray(params["kernel"]).T)}
     if "bias" in params:
         out[f"{prefix}.bias"] = np.asarray(params["bias"])
     return out
 
 
 def resnet_to_torchvision(
-    params: dict, batch_stats: dict, mlp_head: bool = False, prefix: str = ""
+    params: dict, batch_stats: dict, mlp_head: bool | None = None, prefix: str = ""
 ) -> dict[str, np.ndarray]:
     """Flatten a moco_tpu ResNet param tree to torchvision state_dict names.
 
     Name map: `layer{i}_{j}` → `layer{i}.{j}`, `downsample_conv/bn` →
     `downsample.0/1`, v2 MLP head `fc_hidden`/`fc` → `fc.0`/`fc.2` (the
-    reference's `Sequential(Linear, ReLU, Linear)` indices).
+    reference's `Sequential(Linear, ReLU, Linear)` indices). `mlp_head` is
+    auto-detected from the tree (presence of `fc_hidden`) unless forced.
     """
+    if mlp_head is None:
+        mlp_head = "fc_hidden" in params
     stats = batch_stats or {}
     out: dict[str, np.ndarray] = {}
     for name, sub in params.items():
@@ -156,7 +174,7 @@ def resnet_to_torchvision(
 def export_encoder_q(
     state: TrainState,
     path: str,
-    mlp_head: bool = False,
+    mlp_head: bool | None = None,  # auto-detected from the param tree
     prefix: str = "module.encoder_q.",
 ) -> dict[str, np.ndarray]:
     """Write the query encoder in the reference's checkpoint dialect
@@ -184,3 +202,54 @@ def import_encoder_q(path: str) -> dict[str, np.ndarray]:
     from safetensors.numpy import load_file
 
     return load_file(path)
+
+
+def torchvision_to_resnet(
+    flat: dict[str, np.ndarray], prefix: str = "module.encoder_q."
+) -> tuple[dict, dict]:
+    """Inverse of `resnet_to_torchvision`: the lincls "checkpoint surgery"
+    (`main_lincls.py:≈L176-200`) — keep `<prefix>*` backbone entries, strip
+    the prefix, DROP the contrastive head (`fc*`), and rebuild the flax
+    `(params, batch_stats)` trees. Consumes our exports and any checkpoint
+    flattened to the reference's torchvision dialect."""
+
+    def set_nested(tree, keys, value):
+        node = tree
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = value
+
+    params: dict = {}
+    stats: dict = {}
+    for name, arr in flat.items():
+        if not name.startswith(prefix):
+            continue
+        name = name[len(prefix):]
+        parts = name.split(".")
+        if parts[0].startswith("fc"):
+            continue  # contrastive head: dropped, exactly like the reference
+        *mods, leaf = parts
+        # normalize module path: downsample.0/.1 → downsample_conv/_bn
+        if len(mods) >= 2 and mods[-2] == "downsample":
+            mod = "downsample_conv" if mods[-1] == "0" else "downsample_bn"
+            mods = mods[:-2] + [mod]
+        if len(mods) >= 2 and mods[0].startswith("layer"):
+            mods = [f"{mods[0]}_{mods[1]}"] + mods[2:]
+        if leaf == "weight":
+            if arr.ndim == 4:
+                set_nested(params, mods + ["kernel"], arr.transpose(2, 3, 1, 0))
+            elif arr.ndim == 2:
+                set_nested(params, mods + ["kernel"], arr.T)
+            else:  # BN scale
+                set_nested(params, mods + ["scale"], arr)
+        elif leaf == "bias":
+            set_nested(params, mods + ["bias"], arr)
+        elif leaf == "running_mean":
+            set_nested(stats, mods + ["mean"], arr)
+        elif leaf == "running_var":
+            set_nested(stats, mods + ["var"], arr)
+        elif leaf in ("num_batches_tracked",):
+            continue
+        else:
+            raise ValueError(f"unexpected leaf {name!r}")
+    return params, stats

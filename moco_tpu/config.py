@@ -157,6 +157,46 @@ PRESETS["imagenet-moco-v2-8chip"] = PRESETS["imagenet-moco-v2"].replace(
 )
 
 
+# fields whose default is None but which must parse as ints
+_INT_NONE_FIELDS = {"steps_per_epoch"}
+
+
+def add_config_flags(parser, config_cls) -> None:
+    """Expose every dataclass field as a `--flag` (the reference's flat
+    argparse surface). Shared by the train/lincls/knn drivers."""
+    for f in dataclasses.fields(config_cls):
+        name = "--" + f.name.replace("_", "-")
+        if isinstance(f.default, bool):
+            parser.add_argument(
+                name,
+                type=lambda s: s.lower() in ("1", "true", "yes"),
+                default=None,
+            )
+        elif f.name == "schedule":
+            parser.add_argument(name, type=int, nargs="*", default=None)
+        else:
+            caster = (
+                int
+                if f.name in _INT_NONE_FIELDS
+                else type(f.default)
+                if f.default is not None
+                else str
+            )
+            parser.add_argument(name, type=caster, default=None)
+
+
+def collect_overrides(args, config_cls) -> dict:
+    """Non-None parsed flags → dataclass replace() kwargs."""
+    overrides = {
+        f.name: getattr(args, f.name)
+        for f in dataclasses.fields(config_cls)
+        if getattr(args, f.name, None) is not None
+    }
+    if "schedule" in overrides:
+        overrides["schedule"] = tuple(overrides["schedule"])
+    return overrides
+
+
 def get_preset(name: str):
     if name not in PRESETS:
         raise ValueError(f"unknown preset {name!r}; choose from {sorted(PRESETS)}")

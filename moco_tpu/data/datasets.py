@@ -39,8 +39,10 @@ class SyntheticDataset:
         rng = np.random.RandomState(seed)
         self.num_classes = num_classes
         self.image_size = image_size
-        # low-frequency class prototypes: random 4x4 upsampled to full size
-        protos = rng.rand(num_classes, 4, 4, 3)
+        # low-frequency class prototypes: random 4x4 upsampled to full size.
+        # Prototypes come from a FIXED seed so two instances with different
+        # `seed`s (train vs val split) sample the same classes
+        protos = np.random.RandomState(12345).rand(num_classes, 4, 4, 3)
         reps = image_size // 4
         protos = protos.repeat(reps, axis=1).repeat(reps, axis=2)
         labels = rng.randint(0, num_classes, size=num_samples)

@@ -92,6 +92,13 @@ class Prefetcher:
                 break
         self._thread.join(timeout=5.0)
 
+    def _to_device(self, arr, sharding):
+        if jax.process_count() > 1:
+            # multi-host: each host holds only its slice of the global batch;
+            # assemble a global array from per-process shards
+            return jax.make_array_from_process_local_data(sharding, arr)
+        return jax.device_put(arr, sharding)
+
     def __iter__(self) -> Iterator:
         while True:
             item = self._q.get()
@@ -99,8 +106,8 @@ class Prefetcher:
                 return
             imgs, labels = item
             yield (
-                jax.device_put(imgs, self.sharding),
-                jax.device_put(labels, self.label_sharding),
+                self._to_device(imgs, self.sharding),
+                self._to_device(labels, self.label_sharding),
             )
 
     def __len__(self):

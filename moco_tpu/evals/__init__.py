@@ -1,0 +1,10 @@
+from moco_tpu.evals.lincls import train_lincls, load_frozen_backbone, sanity_check
+from moco_tpu.evals.knn import run_knn, encode_dataset
+
+__all__ = [
+    "train_lincls",
+    "load_frozen_backbone",
+    "sanity_check",
+    "run_knn",
+    "encode_dataset",
+]

@@ -1,0 +1,101 @@
+"""Full kNN evaluation on frozen features (BASELINE config 4; SURVEY §2.5,
+§3.3 — InstDisc protocol: top-200 cosine neighbors, votes weighted
+exp(sim/0.07)).
+
+Pipeline (all on device): encode the ENTIRE train set with the frozen query
+encoder into an L2-normalized bank, then score every val image by one
+`[B, dim] x [N_bank, dim]^T` matmul + `top_k` + weighted class vote. Unlike
+the linear probe this has zero trainable parameters.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moco_tpu.config import EvalConfig
+from moco_tpu.data import augment_batch, build_dataset, eval_aug_config
+from moco_tpu.evals.lincls import _val_split, load_frozen_backbone
+from moco_tpu.ops.knn import knn_accuracy
+
+
+def encode_dataset(
+    model,
+    params,
+    stats,
+    dataset,
+    config,
+    batch: int = 256,
+    indices: np.ndarray | None = None,
+    feature_fn=None,
+):
+    """L2-normalized frozen-encoder features (center-crop transform,
+    eval-mode BN) for `dataset` (or a subset via `indices`); the tail chunk
+    is padded so the forward compiles once. Pass a precompiled `feature_fn`
+    (signature `(params, stats, images)`) to reuse a jit cache across calls —
+    the during-training kNN monitor does."""
+    cfg = eval_aug_config(config.image_size)
+    key = jax.random.key(0)
+
+    if feature_fn is None:
+
+        @jax.jit
+        def feature_fn(params, stats, images):
+            out = model.apply(
+                {"params": params, "batch_stats": stats}, images, train=False
+            )
+            return out / jnp.linalg.norm(out, axis=-1, keepdims=True)
+
+    if indices is None:
+        indices = np.arange(len(dataset))
+    feats, labels = [], []
+    for start in range(0, len(indices), batch):
+        idx = indices[start : start + batch]
+        imgs, lbls = dataset.get_batch(idx)
+        valid = len(idx)
+        if valid < batch:
+            imgs = np.concatenate([imgs, np.repeat(imgs[-1:], batch - valid, 0)])
+        images = augment_batch(jnp.asarray(imgs), key, cfg)
+        feats.append(np.asarray(feature_fn(params, stats, images))[:valid])
+        labels.append(lbls)
+    return np.concatenate(feats), np.concatenate(labels)
+
+
+def run_knn(config: EvalConfig) -> float:
+    model, params, stats = load_frozen_backbone(config)
+    train_set = build_dataset(config.dataset, config.data_dir, image_size=config.image_size)
+    val_set = _val_split(config)
+    bank, bank_labels = encode_dataset(model, params, stats, train_set, config)
+    queries, qlabels = encode_dataset(model, params, stats, val_set, config)
+    acc = knn_accuracy(
+        jnp.asarray(queries),
+        jnp.asarray(qlabels),
+        jnp.asarray(bank),
+        jnp.asarray(bank_labels),
+        num_classes=config.num_classes,
+        k=config.knn_k,
+        temperature=config.knn_temperature,
+    )
+    print(f"kNN top-1: {100 * acc:.2f}% (k={config.knn_k}, T={config.knn_temperature})")
+    return acc
+
+
+def main(argv=None):
+    from moco_tpu.config import add_config_flags, collect_overrides
+
+    parser = argparse.ArgumentParser(description="moco_tpu kNN evaluation")
+    add_config_flags(parser, EvalConfig)
+    parser.add_argument("--fake-devices", type=int, default=0)
+    args = parser.parse_args(argv)
+    if args.fake_devices:
+        from moco_tpu.parallel.mesh import force_cpu_devices
+
+        force_cpu_devices(args.fake_devices)
+    run_knn(EvalConfig().replace(**collect_overrides(args, EvalConfig)))
+
+
+if __name__ == "__main__":
+    main()

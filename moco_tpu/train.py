@@ -14,7 +14,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import time
 
 import jax
@@ -59,33 +58,20 @@ def knn_monitor(config, feature_fn, state, dataset, max_bank: int = 4096) -> flo
     monitoring scale: embed a train subset as the bank, score a val subset).
     `feature_fn` comes from `make_feature_fn` ONCE per run (recompiling the
     eval forward every epoch costs minutes on the sandbox)."""
-    from moco_tpu.data.augment import eval_aug_config, augment_batch
+    from moco_tpu.evals.knn import encode_dataset
 
-    cfg = eval_aug_config(config.image_size)
     n = min(len(dataset), max_bank)
     split = int(n * 0.8)
     rng = np.random.RandomState(config.seed)
     idx = rng.permutation(len(dataset))[:n]
-    key = jax.random.key(config.seed)
-
-    def embed(indices):
-        feats, labels = [], []
-        for start in range(0, len(indices), 256):
-            chunk = indices[start : start + 256]
-            imgs, lbls = dataset.get_batch(chunk)
-            valid = len(chunk)
-            if valid < 256:  # pad the tail so shapes (and compiles) are fixed
-                imgs = np.concatenate([imgs, np.repeat(imgs[-1:], 256 - valid, 0)])
-            imgs_f32 = augment_batch(jnp.asarray(imgs), key, cfg)
-            out = np.asarray(
-                feature_fn(state.params_q, state.batch_stats_q, imgs_f32)
-            )
-            feats.append(out[:valid])
-            labels.append(lbls)
-        return np.concatenate(feats), np.concatenate(labels)
-
-    bank, bank_labels = embed(idx[:split])
-    val, val_labels = embed(idx[split:])
+    bank, bank_labels = encode_dataset(
+        None, state.params_q, state.batch_stats_q, dataset, config,
+        indices=idx[:split], feature_fn=feature_fn,
+    )
+    val, val_labels = encode_dataset(
+        None, state.params_q, state.batch_stats_q, dataset, config,
+        indices=idx[split:], feature_fn=feature_fn,
+    )
     return knn_accuracy(
         jnp.asarray(val), jnp.asarray(val_labels), jnp.asarray(bank),
         jnp.asarray(bank_labels), num_classes=dataset.num_classes,
@@ -210,29 +196,9 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
     return state, last_metrics
 
 
-def _add_config_flags(parser: argparse.ArgumentParser):
-    """Reference-style flag surface; every dataclass field is a `--flag`."""
-    for f in dataclasses.fields(PretrainConfig):
-        name = "--" + f.name.replace("_", "-")
-        if f.type == "bool" or isinstance(f.default, bool):
-            parser.add_argument(name, type=lambda s: s.lower() in ("1", "true", "yes"),
-                                default=None)
-        elif f.name == "schedule":
-            parser.add_argument(name, type=int, nargs="*", default=None)
-        elif isinstance(f.default, (int, float, str)) or f.default is None:
-            # fields defaulting to None: int-typed ones listed explicitly
-            caster = (
-                int
-                if f.name in ("steps_per_epoch",)
-                else type(f.default)
-                if f.default is not None
-                else str
-            )
-            parser.add_argument(name, type=caster, default=None)
-    return parser
-
-
 def main(argv=None):
+    from moco_tpu.config import add_config_flags, collect_overrides
+
     parser = argparse.ArgumentParser(description="moco_tpu pretraining")
     pretrain_presets = sorted(
         name for name, cfg in PRESETS.items() if isinstance(cfg, PretrainConfig)
@@ -242,21 +208,15 @@ def main(argv=None):
     parser.add_argument("--num-devices", type=int, default=None)
     parser.add_argument("--fake-devices", type=int, default=0,
                         help="force N fake CPU devices (testing)")
-    _add_config_flags(parser)
+    add_config_flags(parser, PretrainConfig)
     args = parser.parse_args(argv)
     if args.fake_devices:
         from moco_tpu.parallel.mesh import force_cpu_devices
 
         force_cpu_devices(args.fake_devices)
-    config = get_preset(args.preset)
-    overrides = {
-        f.name: getattr(args, f.name)
-        for f in dataclasses.fields(PretrainConfig)
-        if getattr(args, f.name, None) is not None
-    }
-    if "schedule" in overrides:
-        overrides["schedule"] = tuple(overrides["schedule"])
-    config = config.replace(**overrides)
+    config = get_preset(args.preset).replace(
+        **collect_overrides(args, PretrainConfig)
+    )
     mesh = create_mesh(args.num_devices)
     print(f"config: {config}")
     print(f"mesh: {mesh}")

@@ -1,0 +1,97 @@
+"""Checkpoint tests: Orbax bit-faithful resume (queue included, SURVEY §5.4)
+and the torchvision-dialect export/import roundtrip (SURVEY §2.6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from moco_tpu.checkpoint import (
+    checkpoint_manager,
+    export_encoder_q,
+    import_encoder_q,
+    maybe_resume,
+    restore_checkpoint,
+    resnet_to_torchvision,
+    save_checkpoint,
+    torchvision_to_resnet,
+)
+from moco_tpu.models.resnet import ResNetTiny
+from moco_tpu.train_state import create_train_state
+
+
+@pytest.fixture(scope="module")
+def tiny_state():
+    model = ResNetTiny(num_classes=32, cifar_stem=True)
+    tx = optax.sgd(0.1, momentum=0.9)
+    return model, create_train_state(
+        jax.random.key(0), model, tx, (2, 16, 16, 3), 64, 32
+    ), tx
+
+
+def test_orbax_roundtrip_bit_faithful(tiny_state, tmp_path):
+    model, state, tx = tiny_state
+    state = state.replace(queue_ptr=jnp.asarray(32, jnp.int32))
+    mgr = checkpoint_manager(str(tmp_path / "ckpt"))
+    save_checkpoint(mgr, state, 7)
+    mgr.wait_until_finished()
+    fresh = create_train_state(jax.random.key(1), model, tx, (2, 16, 16, 3), 64, 32)
+    restored = restore_checkpoint(mgr, fresh, 7)
+    assert int(restored.queue_ptr) == 32
+    ra = restored.replace(rng=jax.random.key_data(restored.rng))
+    sa = state.replace(rng=jax.random.key_data(state.rng))
+    for a, b in zip(jax.tree.leaves(ra), jax.tree.leaves(sa)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_maybe_resume_auto_and_empty(tiny_state, tmp_path):
+    model, state, tx = tiny_state
+    mgr = checkpoint_manager(str(tmp_path / "empty"))
+    out = maybe_resume(mgr, state, "auto")  # no checkpoint yet → fresh state
+    assert out is state
+    out = maybe_resume(mgr, state, "")
+    assert out is state
+    with pytest.raises(ValueError, match="step directory"):
+        maybe_resume(mgr, state, "/no/such/path")
+
+
+def test_export_import_roundtrip(tiny_state, tmp_path):
+    model, state, tx = tiny_state
+    path = str(tmp_path / "encoder.safetensors")
+    flat = export_encoder_q(state, path)
+    assert any(k.startswith("module.encoder_q.conv1") for k in flat)
+    assert any(".running_mean" in k for k in flat)
+    params, stats = torchvision_to_resnet(import_encoder_q(path))
+    # fc dropped (checkpoint surgery), backbone identical
+    assert "fc" not in params
+    orig = {k: v for k, v in state.params_q.items() if k != "fc"}
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(orig),
+    ):
+        assert jax.tree_util.keystr(pa) == jax.tree_util.keystr(pb)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # running stats preserved too
+    assert stats["bn1"]["mean"].shape == (16,)
+
+
+def test_export_npz_and_mlp_head_names(tmp_path):
+    model = ResNetTiny(num_classes=32, mlp_head=True, cifar_stem=True)
+    tx = optax.sgd(0.1)
+    state = create_train_state(jax.random.key(0), model, tx, (2, 16, 16, 3), 64, 32)
+    path = str(tmp_path / "enc.npz")
+    flat = export_encoder_q(state, path, mlp_head=True)
+    assert "module.encoder_q.fc.0.weight" in flat  # Sequential index names
+    assert "module.encoder_q.fc.2.weight" in flat
+    params, _ = torchvision_to_resnet(import_encoder_q(path))
+    assert "fc" not in params and "fc_hidden" not in params
+
+
+def test_conv_layout_transposed():
+    """flax [kh,kw,cin,cout] ↔ torch [cout,cin,kh,kw]."""
+    kernel = np.arange(3 * 3 * 4 * 8, dtype=np.float32).reshape(3, 3, 4, 8)
+    flat = resnet_to_torchvision({"conv1": {"kernel": kernel}}, {}, prefix="")
+    assert flat["conv1.weight"].shape == (8, 4, 3, 3)
+    back, _ = torchvision_to_resnet({"x.conv1.weight": flat["conv1.weight"]}, "x.")
+    np.testing.assert_array_equal(back["conv1"]["kernel"], kernel)

@@ -1,0 +1,67 @@
+"""Eval drivers: linear probe mechanics/semantics + full kNN eval
+(BASELINE config 4; `main_lincls.py` rebuild)."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from moco_tpu.checkpoint import export_encoder_q
+from moco_tpu.config import EvalConfig
+from moco_tpu.evals.knn import run_knn
+from moco_tpu.evals.lincls import load_frozen_backbone, train_lincls
+from moco_tpu.models.resnet import ResNetTiny
+from moco_tpu.train_state import create_train_state
+
+
+@pytest.fixture(scope="module")
+def exported_ckpt(tmp_path_factory):
+    model = ResNetTiny(num_classes=32, cifar_stem=True)
+    tx = optax.sgd(0.1)
+    state = create_train_state(jax.random.key(0), model, tx, (2, 16, 16, 3), 64, 32)
+    path = str(tmp_path_factory.mktemp("ckpt") / "encoder.safetensors")
+    export_encoder_q(state, path)
+    return path
+
+
+def eval_config(path, **kw):
+    base = dict(
+        arch="resnet_tiny", pretrained=path, dataset="synthetic",
+        image_size=16, cifar_stem=True, num_classes=10, batch_size=64,
+        epochs=1, lr=1.0, print_freq=4,
+    )
+    base.update(kw)
+    return EvalConfig().replace(**base)
+
+
+def test_load_frozen_backbone_surgery(exported_ckpt):
+    config = eval_config(exported_ckpt)
+    model, params, stats = load_frozen_backbone(config)
+    assert "fc" not in params
+    assert "conv1" in params and "layer1_0" in params
+    assert stats["bn1"]["mean"].shape == (16,)
+
+
+def test_load_frozen_backbone_arch_mismatch(exported_ckpt):
+    config = eval_config(exported_ckpt, arch="resnet18")
+    with pytest.raises(ValueError, match="surgery mismatch"):
+        load_frozen_backbone(config)
+
+
+@pytest.mark.slow
+def test_lincls_end_to_end(mesh8, exported_ckpt):
+    """Probe on RANDOM frozen features of clusterable data still beats
+    chance (random projections are linearly separable enough), proving the
+    whole train/validate/sanity-check path."""
+    config = eval_config(exported_ckpt)
+    fc, best_acc1 = train_lincls(config, mesh8, max_steps=24)
+    assert np.isfinite(best_acc1)
+    assert best_acc1 > 15.0, f"probe top-1 {best_acc1} not above 10% chance"
+    assert fc["w"].shape == (32, 10)
+
+
+@pytest.mark.slow
+def test_knn_eval_end_to_end(exported_ckpt):
+    config = eval_config(exported_ckpt, knn_k=20)
+    acc = run_knn(config)
+    assert acc > 0.15, f"kNN top-1 {acc} not above chance"
