@@ -47,8 +47,13 @@ class PretrainConfig:
     sgd_momentum: float = 0.9
     weight_decay: float = 1e-4
     momentum_ramp: bool = False       # v3 cosine m→1 ramp
-    # bookkeeping
+    # bookkeeping / observability (SURVEY §5.1, §5.5)
     print_freq: int = 10              # -p
+    tb_dir: str = ""                  # tensorboard scalar logdir ("" = off)
+    profile_dir: str = ""             # jax.profiler trace logdir ("" = off)
+    profile_start: int = 10           # trace window [start, stop) in steps
+    profile_stop: int = 20
+    debug_nans: bool = False          # jax_debug_nans + finite-loss guard (§5.2)
     ckpt_dir: str = "checkpoints"
     ckpt_every_epochs: int = 1
     resume: str = ""                  # path | "auto"

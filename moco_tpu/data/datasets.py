@@ -101,12 +101,21 @@ class ImageFolder:
     uint8 staging array on the host (shorter-side resize + center crop —
     the final random crop happens on device with full scale range)."""
 
-    def __init__(self, root: str, stage_size: int = 256, num_workers: int = 8):
+    def __init__(
+        self,
+        root: str,
+        stage_size: int = 256,
+        num_workers: int = 8,
+        backend: str = "auto",  # auto | native | pil
+    ):
         from PIL import Image  # lazy: torch-free PIL dependency
 
         self._Image = Image
         self.stage_size = stage_size
         self.image_size = stage_size
+        self._native = None
+        self._backend = backend
+        self._native_workers = num_workers
         classes = sorted(
             d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
         )
@@ -125,6 +134,21 @@ class ImageFolder:
                     )
         self.labels = np.asarray([e.label for e in self.entries], np.int32)
         self._pool = ThreadPoolExecutor(max_workers=num_workers)
+        # native decode path only pays off (and only works) for JPEG trees —
+        # don't compile/spawn the C++ loader for PNG/BMP/WebP datasets
+        has_jpeg = any(
+            e.path.lower().endswith((".jpg", ".jpeg")) for e in self.entries
+        )
+        if self._backend in ("auto", "native") and has_jpeg:
+            try:
+                from moco_tpu.data.native_loader import NativeStagingLoader
+
+                self._native = NativeStagingLoader(stage_size, self._native_workers)
+            except (RuntimeError, OSError):
+                if self._backend == "native":
+                    raise
+        elif self._backend == "native" and not has_jpeg:
+            raise RuntimeError("backend='native' requires JPEG images")
 
     def __len__(self):
         return len(self.entries)
@@ -141,7 +165,16 @@ class ImageFolder:
         return np.asarray(img, np.uint8)
 
     def get_batch(self, indices: np.ndarray):
-        imgs = list(self._pool.map(self._load_one, [int(i) for i in indices]))
+        idx = [int(i) for i in indices]
+        paths = [self.entries[i].path for i in idx]
+        if self._native is not None and all(
+            p.lower().endswith((".jpg", ".jpeg")) for p in paths
+        ):
+            imgs, failures = self._native.load_batch(paths)
+            if failures == 0:
+                return imgs, self.labels[indices]
+            # corrupt files: fall through to PIL for a precise error surface
+        imgs = list(self._pool.map(self._load_one, idx))
         return np.stack(imgs), self.labels[indices]
 
 

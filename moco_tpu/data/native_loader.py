@@ -1,0 +1,91 @@
+"""ctypes binding for the native C++ staging loader (native/staging_loader.cc).
+
+The reference leans on native code for its input path — PIL/libjpeg decode in
+32 worker processes (`main_moco.py:≈L260-270`), or NVIDIA DALI in the bl0
+fork (SURVEY §2.10). This is the TPU-native equivalent: a C++ thread pool in
+the single controller process that turns JPEG files into fixed-size uint8
+staging tiles (decode → shorter-side bilinear resize → center crop); the
+randomized augmentation then runs ON DEVICE (data/augment.py).
+
+The shared library is compiled on first use (g++ + libjpeg, both in the
+image); if the toolchain is unavailable, `ImageFolder` silently falls back
+to the PIL path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libstaging_loader.so"))
+_build_lock = threading.Lock()
+
+
+def _ensure_built() -> str | None:
+    """Compile the library if needed; None if the build is impossible."""
+    with _build_lock:
+        src = os.path.join(_NATIVE_DIR, "staging_loader.cc")
+        if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src):
+            return _LIB_PATH
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_NATIVE_DIR), "libstaging_loader.so"],
+                check=True,
+                capture_output=True,
+            )
+            return _LIB_PATH
+        except (subprocess.CalledProcessError, FileNotFoundError):
+            return None
+
+
+class NativeStagingLoader:
+    """Threaded JPEG→staging-tile batch loader. Raises RuntimeError if the
+    native library cannot be built (callers fall back to PIL)."""
+
+    def __init__(self, stage_size: int, num_threads: int | None = None):
+        path = _ensure_built()
+        if path is None:
+            raise RuntimeError("native staging loader unavailable (build failed)")
+        self._lib = ctypes.CDLL(path)
+        self._lib.sl_create.restype = ctypes.c_void_p
+        self._lib.sl_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        self._lib.sl_load_batch.restype = ctypes.c_int
+        self._lib.sl_load_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        self._lib.sl_destroy.argtypes = [ctypes.c_void_p]
+        if num_threads is None:
+            num_threads = max(os.cpu_count() or 1, 1)
+        self.stage_size = stage_size
+        self._handle = self._lib.sl_create(num_threads, stage_size)
+        if not self._handle:
+            raise RuntimeError("sl_create failed")
+
+    def load_batch(self, paths: list[str]) -> tuple[np.ndarray, int]:
+        """Decode `paths` in parallel → (`[n, S, S, 3] uint8`, n_failures).
+        Failed images come back as zero tiles."""
+        n = len(paths)
+        s = self.stage_size
+        out = np.empty((n, s, s, 3), dtype=np.uint8)
+        arr = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+        failures = self._lib.sl_load_batch(
+            self._handle,
+            arr,
+            n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return out, int(failures)
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.sl_destroy(handle)
+            self._handle = None

@@ -1,0 +1,103 @@
+"""Detectron2 checkpoint converter (rebuild of
+`detection/convert-pretrain-to-detectron2.py`, SURVEY §2.6/§3.4).
+
+The reference's transfer story: strip `module.encoder_q.`, rename torchvision
+ResNet keys to Detectron2's C4 naming, write a `.pkl` that Detectron2's
+checkpointer loads with `matching_heuristics`. Same contract here, torch-free
+(pure numpy + pickle), consuming either our safetensors/npz export or —
+since the dialect matches — any reference-style flat checkpoint.
+
+Name map (torchvision → Detectron2 R50-C4):
+    conv1.*               → backbone prefix `stem.conv1.*`
+    bn1.{w,b,rm,rv}       → `stem.conv1.norm.{weight,bias,running_mean,running_var}`
+    layer{i}.{j}.convK/bnK → `res{i+1}.{j}.convK{,.norm}`
+    layer{i}.{j}.downsample.0/1 → `res{i+1}.{j}.shortcut{,.norm}`
+    fc.*                  → dropped (detection has no classifier head)
+
+Usage: python -m moco_tpu.export_detectron2 encoder.safetensors out.pkl
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+
+import numpy as np
+
+from moco_tpu.checkpoint import import_encoder_q
+
+_BN_LEAVES = {
+    "weight": "norm.weight",
+    "bias": "norm.bias",
+    "running_mean": "norm.running_mean",
+    "running_var": "norm.running_var",
+}
+
+
+def torchvision_flat_to_detectron2(
+    flat: dict[str, np.ndarray], prefix: str = "module.encoder_q."
+) -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for name, arr in flat.items():
+        if not name.startswith(prefix):
+            continue
+        name = name[len(prefix):]
+        parts = name.split(".")
+        if parts[0].startswith("fc"):
+            continue
+        if parts[-1] == "num_batches_tracked":
+            continue  # torch BN bookkeeping; Detectron2 has no equivalent
+        if parts[0] == "conv1":
+            out["stem.conv1." + ".".join(parts[1:])] = np.asarray(arr)
+        elif parts[0] == "bn1":
+            out["stem.conv1." + _BN_LEAVES[parts[1]]] = np.asarray(arr)
+        elif parts[0].startswith("layer"):
+            stage = int(parts[0][len("layer"):])
+            block = parts[1]
+            rest = parts[2:]
+            base = f"res{stage + 1}.{block}"
+            if rest[0].startswith("conv"):
+                out[f"{base}.{rest[0]}.{'.'.join(rest[1:])}"] = np.asarray(arr)
+            elif rest[0].startswith("bn"):
+                conv = "conv" + rest[0][len("bn"):]
+                out[f"{base}.{conv}.{_BN_LEAVES[rest[1]]}"] = np.asarray(arr)
+            elif rest[0] == "downsample":
+                leaf = (
+                    "shortcut." + ".".join(rest[2:])
+                    if rest[1] == "0"
+                    else "shortcut." + _BN_LEAVES[rest[2]]
+                )
+                out[f"{base}.{leaf}"] = np.asarray(arr)
+            else:
+                raise ValueError(f"unexpected key {name!r}")
+        else:
+            raise ValueError(f"unexpected key {name!r}")
+    if not out:
+        raise ValueError(f"no {prefix}* entries found")
+    return out
+
+
+def convert(src: str, dst: str, prefix: str = "module.encoder_q.") -> dict:
+    model = torchvision_flat_to_detectron2(import_encoder_q(src), prefix)
+    obj = {
+        "model": model,
+        "__author__": "moco_tpu",
+        "matching_heuristics": True,
+    }
+    with open(dst, "wb") as f:
+        pickle.dump(obj, f)
+    return model
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("input", help="exported encoder (.safetensors / .npz)")
+    parser.add_argument("output", help="Detectron2-format .pkl")
+    parser.add_argument("--prefix", default="module.encoder_q.")
+    args = parser.parse_args(argv)
+    model = convert(args.input, args.output, args.prefix)
+    print(f"wrote {args.output} with {len(model)} tensors")
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,7 @@ from moco_tpu.ops.knn import knn_accuracy
 from moco_tpu.parallel.mesh import create_mesh, local_batch_size
 from moco_tpu.train_state import create_train_state
 from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
+from moco_tpu.utils.logging import ProfilerWindow, ScalarWriter
 from moco_tpu.utils.meters import AverageMeter, ProgressMeter, Throughput
 
 
@@ -83,6 +84,10 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
     """Run pretraining; returns (final_state, last_metrics_dict)."""
     if mesh is None:
         mesh = create_mesh()
+    if config.debug_nans:
+        # numeric sanitizer (SURVEY §5.2): raise at the op that produced the
+        # first NaN instead of training through garbage
+        jax.config.update("jax_debug_nans", True)
     n_chips = mesh.size
     local_b = local_batch_size(config.batch_size, mesh)  # validates divisibility
 
@@ -136,61 +141,88 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
     total_steps = max_steps or config.epochs * steps_per_epoch
     last_metrics: dict = {}
     feature_fn = make_feature_fn(model, config.variant) if config.knn_monitor else None
+    # observability on process 0 only: every host writing the same tags into
+    # one tb_dir duplicates curves, and concurrent profiler traces race
+    is_main = jax.process_index() == 0
+    writer = ScalarWriter(config.tb_dir if is_main else "")
+    profiler = ProfilerWindow(
+        config.profile_dir if is_main else "", config.profile_start, config.profile_stop
+    )
     done = False
 
-    for epoch in range(start_epoch, config.epochs):
-        if done:
-            break
-        batch_time = AverageMeter("Time", ":6.3f")
-        data_time = AverageMeter("Data", ":6.3f")
-        losses = AverageMeter("Loss", ":.4e")
-        top1 = AverageMeter("Acc@1", ":6.2f")
-        top5 = AverageMeter("Acc@5", ":6.2f")
-        progress = ProgressMeter(
-            steps_per_epoch,
-            [batch_time, data_time, losses, top1, top5],
-            prefix=f"Epoch: [{epoch}]",
-        )
-        throughput = Throughput(n_chips)
-        loader = epoch_loader(dataset, epoch, config.seed, config.batch_size, mesh)
-        end = time.perf_counter()
-        try:
-            for i, (imgs, _labels) in enumerate(loader):
-                if i >= steps_per_epoch:  # steps_per_epoch may cap the epoch
-                    break
-                data_time.update(time.perf_counter() - end)
-                step_key = jax.random.fold_in(data_key, global_step)
-                im_q, im_k = two_crops(imgs, step_key, aug_cfg)
-                state, metrics = step_fn(state, im_q, im_k)
-                global_step += 1
-                if i % config.print_freq == 0:
-                    # pull metrics (host sync) only when printing
-                    last_metrics = {k: float(v) for k, v in metrics.items()}
-                    losses.update(last_metrics["loss"], config.batch_size)
-                    top1.update(last_metrics.get("acc1", 0.0), config.batch_size)
-                    top5.update(last_metrics.get("acc5", 0.0), config.batch_size)
-                    progress.display(i)
-                throughput.update(config.batch_size)
-                batch_time.update(time.perf_counter() - end)
-                end = time.perf_counter()
-                if global_step >= total_steps:
-                    done = True
-                    break
-        finally:
-            loader.close()  # unblock the prefetch thread on early break
-        print(
-            f"Epoch [{epoch}] imgs/sec {throughput.imgs_per_sec:.1f} "
-            f"({throughput.imgs_per_sec_per_chip:.1f}/chip)",
-            flush=True,
-        )
-        if config.knn_monitor:
-            acc = knn_monitor(config, feature_fn, state, dataset)
-            last_metrics["knn_top1"] = acc
-            print(f"Epoch [{epoch}] kNN top-1 {100 * acc:.2f}%", flush=True)
-        if mgr is not None and (epoch + 1) % config.ckpt_every_epochs == 0:
-            # unlike the reference's rank-0-only torch.save, Orbax saving of
-            # multi-process arrays is COLLECTIVE — every process must call it
-            save_checkpoint(mgr, state, global_step)
+    try:
+        for epoch in range(start_epoch, config.epochs):
+            if done:
+                break
+            batch_time = AverageMeter("Time", ":6.3f")
+            data_time = AverageMeter("Data", ":6.3f")
+            losses = AverageMeter("Loss", ":.4e")
+            top1 = AverageMeter("Acc@1", ":6.2f")
+            top5 = AverageMeter("Acc@5", ":6.2f")
+            progress = ProgressMeter(
+                steps_per_epoch,
+                [batch_time, data_time, losses, top1, top5],
+                prefix=f"Epoch: [{epoch}]",
+            )
+            throughput = Throughput(n_chips)
+            loader = epoch_loader(dataset, epoch, config.seed, config.batch_size, mesh)
+            end = time.perf_counter()
+            try:
+                for i, (imgs, _labels) in enumerate(loader):
+                    if i >= steps_per_epoch:  # steps_per_epoch may cap the epoch
+                        break
+                    data_time.update(time.perf_counter() - end)
+                    step_key = jax.random.fold_in(data_key, global_step)
+                    im_q, im_k = two_crops(imgs, step_key, aug_cfg)
+                    profiler.maybe_toggle(global_step)
+                    state, metrics = step_fn(state, im_q, im_k)
+                    global_step += 1
+                    if i % config.print_freq == 0:
+                        # pull metrics (host sync) only when printing
+                        last_metrics = {k: float(v) for k, v in metrics.items()}
+                        if config.debug_nans and not np.isfinite(last_metrics["loss"]):
+                            raise FloatingPointError(
+                                f"non-finite loss {last_metrics['loss']} at step {global_step}"
+                            )
+                        losses.update(last_metrics["loss"], config.batch_size)
+                        top1.update(last_metrics.get("acc1", 0.0), config.batch_size)
+                        top5.update(last_metrics.get("acc5", 0.0), config.batch_size)
+                        progress.display(i)
+                        writer.write(
+                            global_step,
+                            dict(
+                                last_metrics,
+                                imgs_per_sec=throughput.imgs_per_sec,
+                                imgs_per_sec_per_chip=throughput.imgs_per_sec_per_chip,
+                            ),
+                        )
+                    throughput.update(config.batch_size)
+                    batch_time.update(time.perf_counter() - end)
+                    end = time.perf_counter()
+                    if global_step >= total_steps:
+                        done = True
+                        break
+            finally:
+                loader.close()  # unblock the prefetch thread on early break
+            print(
+                f"Epoch [{epoch}] imgs/sec {throughput.imgs_per_sec:.1f} "
+                f"({throughput.imgs_per_sec_per_chip:.1f}/chip)",
+                flush=True,
+            )
+            if config.knn_monitor:
+                acc = knn_monitor(config, feature_fn, state, dataset)
+                last_metrics["knn_top1"] = acc
+                print(f"Epoch [{epoch}] kNN top-1 {100 * acc:.2f}%", flush=True)
+                writer.write(global_step, {"knn_top1": acc})
+            if mgr is not None and (epoch + 1) % config.ckpt_every_epochs == 0:
+                # unlike the reference's rank-0-only torch.save, Orbax saving of
+                # multi-process arrays is COLLECTIVE — every process must call it
+                save_checkpoint(mgr, state, global_step)
+    finally:
+        # always land the profiler trace and flush buffered scalars,
+        # even when the loop raises (debug_nans, data errors, ^C)
+        profiler.close()
+        writer.close()
     if mgr is not None:
         mgr.wait_until_finished()
     return state, last_metrics

@@ -1,0 +1,65 @@
+"""Scalar logging + profiling hooks (SURVEY §5.1/§5.5 build targets).
+
+The reference has stdout meters only; the bl0 fork adds optional TensorBoard
+scalars. Here: a thin tensorboardX writer (no-op when disabled or when the
+package is missing) and a `jax.profiler` trace window — the traces open in
+TensorBoard's profile plugin for MXU/HBM analysis."""
+
+from __future__ import annotations
+
+
+class ScalarWriter:
+    """tensorboardX SummaryWriter wrapper; silently no-ops when `logdir` is
+    empty or tensorboardX is unavailable."""
+
+    def __init__(self, logdir: str = ""):
+        self._writer = None
+        if logdir:
+            try:
+                from tensorboardX import SummaryWriter
+
+                self._writer = SummaryWriter(logdir)
+            except ImportError:
+                print(f"tensorboardX unavailable; not writing scalars to {logdir}")
+
+    def write(self, step: int, scalars: dict) -> None:
+        if self._writer is None:
+            return
+        for name, value in scalars.items():
+            try:
+                self._writer.add_scalar(name, float(value), step)
+            except (TypeError, ValueError):
+                continue
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+
+class ProfilerWindow:
+    """Trace steps [start, stop) with jax.profiler into `logdir/plugins/...`
+    (viewable with tensorboard-plugin-profile). Inactive when logdir == ""."""
+
+    def __init__(self, logdir: str, start: int, stop: int):
+        self.logdir, self.start, self.stop = logdir, start, stop
+        self._active = False
+
+    def maybe_toggle(self, step: int) -> None:
+        if not self.logdir:
+            return
+        import jax
+
+        if not self._active and self.start <= step < self.stop:
+            # range check (not ==): a resumed run may start past `start`
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif self._active and step >= self.stop:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False

@@ -1,0 +1,222 @@
+// Native host-side image staging pipeline (SURVEY §2.10: the TPU-native
+// equivalent of the reference's C-backed input path — PIL/libjpeg-turbo
+// decode inside 32 DataLoader worker PROCESSES, or the bl0-fork's DALI
+// option). One shared library, a pool of decode THREADS inside the single
+// controller process:
+//
+//   JPEG bytes --(libjpeg decode)--> RGB --(bilinear shorter-side resize)-->
+//   --(center crop)--> uint8 [S, S, 3] staging tile
+//
+// The randomized augmentation does NOT happen here — it runs on-device
+// (moco_tpu/data/augment.py). This library only turns compressed files into
+// fixed-size uint8 staging tiles as fast as the host allows, the one part of
+// the input path that cannot run on the TPU.
+//
+// C ABI (consumed via ctypes from moco_tpu/data/native_loader.py):
+//   void* sl_create(int num_threads, int stage_size);
+//   int   sl_load_batch(void* h, const char** paths, int n, uint8_t* out);
+//         // out: n * S * S * 3 bytes; returns 0 on success, else the number
+//         // of failed images (failed slots are zero-filled)
+//   void  sl_destroy(void* h);
+
+#include <cstdio>  // must precede jpeglib.h (it needs FILE declared)
+
+#include <jpeglib.h>
+
+#include <atomic>
+#include <algorithm>
+#include <cmath>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// libjpeg decode with longjmp error recovery (corrupt files must not abort)
+// ---------------------------------------------------------------------------
+
+struct JpegErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void jpeg_error_exit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+// Decode a JPEG file to RGB. Returns false on any decode error.
+bool decode_jpeg(const char* path, std::vector<uint8_t>* rgb, int* w, int* h) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_error_exit;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    std::fclose(f);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;  // force 3-channel (gray/CMYK inputs too)
+  jpeg_start_decompress(&cinfo);
+  *w = static_cast<int>(cinfo.output_width);
+  *h = static_cast<int>(cinfo.output_height);
+  rgb->resize(static_cast<size_t>(*w) * *h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = rgb->data() + static_cast<size_t>(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  std::fclose(f);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// bilinear shorter-side resize + center crop to S x S (uint8, RGB)
+// ---------------------------------------------------------------------------
+
+void resize_center_crop(const uint8_t* src, int w, int h, int s, uint8_t* dst) {
+  const float scale = static_cast<float>(s) / std::min(w, h);
+  const int rw = std::max(s, static_cast<int>(std::lround(w * scale)));
+  const int rh = std::max(s, static_cast<int>(std::lround(h * scale)));
+  const int x_off = (rw - s) / 2;
+  const int y_off = (rh - s) / 2;
+  // map output pixel -> source coordinate (align-corners=false convention)
+  const float sx = static_cast<float>(w) / rw;
+  const float sy = static_cast<float>(h) / rh;
+  for (int y = 0; y < s; ++y) {
+    const float fy = (y + y_off + 0.5f) * sy - 0.5f;
+    const int y0 = std::clamp(static_cast<int>(std::floor(fy)), 0, h - 1);
+    const int y1 = std::min(y0 + 1, h - 1);
+    const float wy = std::clamp(fy - y0, 0.0f, 1.0f);
+    for (int x = 0; x < s; ++x) {
+      const float fx = (x + x_off + 0.5f) * sx - 0.5f;
+      const int x0 = std::clamp(static_cast<int>(std::floor(fx)), 0, w - 1);
+      const int x1 = std::min(x0 + 1, w - 1);
+      const float wx = std::clamp(fx - x0, 0.0f, 1.0f);
+      const uint8_t* p00 = src + (static_cast<size_t>(y0) * w + x0) * 3;
+      const uint8_t* p01 = src + (static_cast<size_t>(y0) * w + x1) * 3;
+      const uint8_t* p10 = src + (static_cast<size_t>(y1) * w + x0) * 3;
+      const uint8_t* p11 = src + (static_cast<size_t>(y1) * w + x1) * 3;
+      uint8_t* out = dst + (static_cast<size_t>(y) * s + x) * 3;
+      for (int c = 0; c < 3; ++c) {
+        const float top = p00[c] + (p01[c] - p00[c]) * wx;
+        const float bot = p10[c] + (p11[c] - p10[c]) * wx;
+        out[c] = static_cast<uint8_t>(std::lround(top + (bot - top) * wy));
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// thread pool
+// ---------------------------------------------------------------------------
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n) {
+    for (int i = 0; i < n; ++i) {
+      workers_.emplace_back([this] { Loop(); });
+    }
+  }
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+  void Submit(std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      tasks_.push(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  void Loop() {
+    for (;;) {
+      std::function<void()> task;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stop_ || !tasks_.empty(); });
+        if (stop_ && tasks_.empty()) return;
+        task = std::move(tasks_.front());
+        tasks_.pop();
+      }
+      task();
+    }
+  }
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::queue<std::function<void()>> tasks_;
+  std::vector<std::thread> workers_;
+  bool stop_ = false;
+};
+
+struct Loader {
+  ThreadPool pool;
+  int stage_size;
+  Loader(int threads, int s) : pool(threads), stage_size(s) {}
+};
+
+}  // namespace
+
+extern "C" {
+
+void* sl_create(int num_threads, int stage_size) {
+  if (num_threads < 1 || stage_size < 1) return nullptr;
+  return new Loader(num_threads, stage_size);
+}
+
+int sl_load_batch(void* handle, const char** paths, int n, uint8_t* out) {
+  auto* loader = static_cast<Loader*>(handle);
+  const int s = loader->stage_size;
+  const size_t tile = static_cast<size_t>(s) * s * 3;
+  std::atomic<int> failures{0};
+  // `remaining` is a plain int guarded by done_mu: the decrement must happen
+  // UNDER the lock, otherwise the waiter can observe 0 (spurious wake) and
+  // destroy these stack objects while the last worker is still about to
+  // lock them (use-after-free).
+  int remaining = n;
+  std::mutex done_mu;
+  std::condition_variable done_cv;
+  for (int i = 0; i < n; ++i) {
+    loader->pool.Submit([&, i] {
+      std::vector<uint8_t> rgb;
+      int w = 0, h = 0;
+      if (decode_jpeg(paths[i], &rgb, &w, &h) && w > 0 && h > 0) {
+        resize_center_crop(rgb.data(), w, h, s, out + i * tile);
+      } else {
+        std::memset(out + i * tile, 0, tile);
+        failures.fetch_add(1);
+      }
+      {
+        std::lock_guard<std::mutex> lk(done_mu);
+        if (--remaining == 0) done_cv.notify_one();
+      }
+    });
+  }
+  std::unique_lock<std::mutex> lk(done_mu);
+  done_cv.wait(lk, [&] { return remaining == 0; });
+  return failures.load();
+}
+
+void sl_destroy(void* handle) { delete static_cast<Loader*>(handle); }
+
+}  // extern "C"

@@ -1,0 +1,59 @@
+"""Detectron2 converter tests (SURVEY §2.6 transfer-export parity)."""
+
+import pickle
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from moco_tpu.checkpoint import export_encoder_q
+from moco_tpu.export_detectron2 import convert, torchvision_flat_to_detectron2
+from moco_tpu.models.resnet import ResNetTiny
+from moco_tpu.train_state import create_train_state
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    model = ResNetTiny(num_classes=32, cifar_stem=True)
+    state = create_train_state(
+        jax.random.key(0), model, optax.sgd(0.1), (2, 16, 16, 3), 64, 32
+    )
+    path = str(tmp_path_factory.mktemp("exp") / "enc.safetensors")
+    flat = export_encoder_q(state, path)
+    return path, flat, state
+
+
+def test_convert_writes_loadable_pickle(exported, tmp_path):
+    path, flat, state = exported
+    out = str(tmp_path / "d2.pkl")
+    model = convert(path, out)
+    with open(out, "rb") as f:
+        obj = pickle.load(f)
+    assert obj["matching_heuristics"] is True
+    assert set(obj["model"]) == set(model)
+
+
+def test_name_mapping(exported):
+    path, flat, state = exported
+    model = torchvision_flat_to_detectron2(flat)
+    assert "stem.conv1.weight" in model
+    assert "stem.conv1.norm.running_mean" in model
+    # layer1 → res2, block 0
+    assert "res2.0.conv1.weight" in model
+    assert "res2.0.conv1.norm.weight" in model
+    # layer2 has a downsample in ResNetTiny → shortcut names
+    assert "res3.0.shortcut.weight" in model
+    assert "res3.0.shortcut.norm.running_var" in model
+    # no classifier head survives
+    assert not any(k.startswith("fc") for k in model)
+    # tensor values pass through untouched
+    np.testing.assert_array_equal(
+        model["stem.conv1.weight"], flat["module.encoder_q.conv1.weight"]
+    )
+
+
+def test_wrong_prefix_errors(exported):
+    path, flat, state = exported
+    with pytest.raises(ValueError, match="no nope"):
+        torchvision_flat_to_detectron2(flat, prefix="nope")

@@ -24,11 +24,21 @@ def test_moco_v1_smoke_loss_falls_knn_above_chance(mesh8, tmp_path):
         steps_per_epoch=16,
         knn_monitor=True,
         ckpt_dir=str(tmp_path / "ckpt"),
+        tb_dir=str(tmp_path / "tb"),
         print_freq=8,
         num_classes=10,
     )
     state, metrics = train(config, mesh8)
     assert int(state.step) == 48
+    try:
+        import tensorboardX  # noqa: F401  (optional dep; writer no-ops without it)
+    except ImportError:
+        pass
+    else:
+        import os
+
+        tb_files = os.listdir(tmp_path / "tb")
+        assert any("tfevents" in f for f in tb_files), tb_files
     # loss fell below the trivial-collapse plateau and is finite
     assert np.isfinite(metrics["loss"])
     # 10-class synthetic data: chance = 10%; the features must beat it well
