@@ -252,6 +252,12 @@ def augment_batch(images_u8: jax.Array, key: jax.Array, cfg: AugConfig) -> jax.A
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def two_crops(images_u8: jax.Array, key: jax.Array, cfg: AugConfig):
     """The `TwoCropsTransform`: two INDEPENDENT draws of the same pipeline
-    (`moco/loader.py:≈L8-18`) → `(im_q, im_k)`."""
+    (`moco/loader.py:≈L8-18`) → `(im_q, im_k)`, one jitted program.
+
+    Deliberately two [B] vmapped draws, NOT a concatenated [2B] pass: with
+    the batch sharded P('data'), `concatenate([x, x], 0)` makes GSPMD
+    reshard the whole batch across chips every step (measured: 12
+    collective-permutes + 20 all-to-alls in the compiled HLO vs ZERO for
+    this form)."""
     kq, kk = jax.random.split(key)
     return augment_batch(images_u8, kq, cfg), augment_batch(images_u8, kk, cfg)
