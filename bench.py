@@ -24,7 +24,7 @@ BASELINE_IMGS_PER_SEC_PER_CHIP = 168.0  # 8xV100 MoCo-v2, BASELINE.md
 
 def main():
     from moco_tpu.config import get_preset
-    from moco_tpu.data.augment import two_crops, v2_aug_config
+    from moco_tpu.data.augment import build_two_crops_sharded, v2_aug_config
     from moco_tpu.parallel.mesh import create_mesh
     from moco_tpu.train_state import create_train_state
     from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
@@ -61,6 +61,7 @@ def main():
     step_fn = build_train_step(config, model, tx, mesh, 1000, sched)
 
     aug_cfg = v2_aug_config(config.image_size)
+    two_crops = build_two_crops_sharded(aug_cfg, mesh)
     # one staged uint8 batch; re-augmented on device every step (two_crops),
     # representing the steady-state input path with host decode amortized
     stage = config.image_size + config.image_size // 8
@@ -71,7 +72,7 @@ def main():
     data_key = jax.random.key(1)
 
     def one_step(state, i):
-        im_q, im_k = two_crops(imgs_u8, jax.random.fold_in(data_key, i), aug_cfg)
+        im_q, im_k = two_crops(imgs_u8, jax.random.fold_in(data_key, i))
         return step_fn(state, im_q, im_k)
 
     # Timing notes (measured on the sandbox's tunneled v5e):

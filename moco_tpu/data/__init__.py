@@ -1,6 +1,7 @@
 from moco_tpu.data.augment import (
     AugConfig,
     augment_batch,
+    build_two_crops_sharded,
     eval_aug_config,
     two_crops,
     v1_aug_config,
@@ -12,6 +13,7 @@ from moco_tpu.data.loader import Prefetcher, epoch_loader, epoch_permutation, ho
 __all__ = [
     "AugConfig",
     "augment_batch",
+    "build_two_crops_sharded",
     "eval_aug_config",
     "two_crops",
     "v1_aug_config",

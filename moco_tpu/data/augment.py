@@ -51,6 +51,7 @@ class AugConfig(NamedTuple):
     blur_sigma: tuple[float, float] = (0.1, 2.0)
     flip_prob: float = 0.5
     deterministic: bool = False   # eval: fixed-aspect center crop, no randomness
+    pallas_blur: str = "auto"     # auto (TPU only) | on | off — see ops/pallas_blur.py
 
 
 def v1_aug_config(out_size: int = 224) -> AugConfig:
@@ -153,14 +154,12 @@ def _random_grayscale(img, key, cfg: AugConfig):
 
 
 def _gaussian_blur(img, key, cfg: AugConfig):
-    ksig, kp = jax.random.split(key)
-    sigma = jax.random.uniform(
-        ksig, (), minval=cfg.blur_sigma[0], maxval=cfg.blur_sigma[1]
-    )
-    radius = max(1, int(0.05 * cfg.out_size))  # fixed width; weights carry sigma
-    offs = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
-    kernel = jnp.exp(-0.5 * (offs / sigma) ** 2)
-    kernel = kernel / jnp.sum(kernel)
+    from moco_tpu.ops.pallas_blur import blur_radius, blur_weights
+
+    radius = blur_radius(cfg.out_size)
+    # sigma + apply-probability sampling shared with the Pallas path (one
+    # source of truth; skip == identity kernel, so it is applied unconditionally)
+    kernel = blur_weights(key, radius, cfg.blur_sigma, cfg.blur_prob)
     # Separable blur as weighted shifted-adds over STATIC slices. Two designs
     # were measured and rejected on the v5e: slice-stack + einsum fuses the
     # whole upstream jitter chain into every tap (~20x recompute), and a
@@ -181,9 +180,7 @@ def _gaussian_blur(img, key, cfg: AugConfig):
             acc = acc + kernel[i] * padded[tuple(sl)]
         return acc
 
-    blurred = conv1d(conv1d(img_b, 0), 1)
-    apply = jax.random.uniform(kp, ()) < cfg.blur_prob
-    return jnp.where(apply, blurred, img)
+    return conv1d(conv1d(img_b, 0), 1)
 
 
 def _random_resized_crop(img, key, cfg: AugConfig):
@@ -227,7 +224,7 @@ def _random_flip(img, key, cfg: AugConfig):
     return jnp.where(apply, img[:, ::-1, :], img)
 
 
-def _augment_one(img_u8, key, cfg: AugConfig):
+def _augment_one(img_u8, key, cfg: AugConfig, skip_blur: bool = False):
     img = img_u8.astype(jnp.float32) / 255.0
     kcrop, kjit, kgray, kblur, kflip = jax.random.split(key, 5)
     img = _random_resized_crop(img, kcrop, cfg)
@@ -235,18 +232,65 @@ def _augment_one(img_u8, key, cfg: AugConfig):
         img = _color_jitter(img, kjit, cfg)
     if cfg.grayscale_prob > 0:
         img = _random_grayscale(img, kgray, cfg)
-    if cfg.blur_prob > 0:
+    if cfg.blur_prob > 0 and not skip_blur:
         img = _gaussian_blur(img, kblur, cfg)
     img = _random_flip(img, kflip, cfg)
     return (img - IMAGENET_MEAN) / IMAGENET_STD
 
 
+def _use_pallas_blur(cfg: AugConfig) -> bool:
+    if cfg.blur_prob <= 0 or cfg.pallas_blur == "off":
+        return False
+    if cfg.pallas_blur == "on":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _sample_keys(key: jax.Array, start, n: int) -> jax.Array:
+    """Per-sample keys by GLOBAL sample index (`fold_in(key, start+i)`), so a
+    device holding shard [start, start+n) of the batch derives exactly the
+    keys the unsharded pipeline would use for those samples."""
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(start + jnp.arange(n))
+
+
+def _augment_with_keys(images_u8: jax.Array, keys: jax.Array, cfg: AugConfig) -> jax.Array:
+    """Core batched pipeline given explicit per-sample keys.
+
+    When the Pallas path is active, the blur is lifted out of the per-sample
+    pipeline and applied as a VMEM stencil kernel over the finished batch —
+    equivalent within float32 tolerance (the symmetric sum-1 kernel commutes
+    with the flip and with the affine normalize; see
+    tests/test_pallas_blur.py) but one HBM round-trip instead of ~46
+    shifted-add passes. Same per-sample PRNG stream either way."""
+    use_pallas = _use_pallas_blur(cfg)
+    out = jax.vmap(_augment_one, in_axes=(0, 0, None, None))(
+        images_u8, keys, cfg, use_pallas
+    )
+    if use_pallas:
+        from moco_tpu.ops.pallas_blur import (
+            blur_radius,
+            blur_weights,
+            gaussian_blur_batch,
+        )
+
+        radius = blur_radius(cfg.out_size)
+        kblurs = jax.vmap(lambda k: jax.random.split(k, 5)[3])(keys)
+        weights = jax.vmap(
+            lambda k: blur_weights(k, radius, cfg.blur_sigma, cfg.blur_prob)
+        )(kblurs)
+        out = gaussian_blur_batch(
+            out, weights, radius, interpret=jax.default_backend() != "tpu"
+        )
+    return out
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def augment_batch(images_u8: jax.Array, key: jax.Array, cfg: AugConfig) -> jax.Array:
     """`[B, H, W, 3] uint8 → [B, S, S, 3] float32` — one independent random
-    draw per sample (vmapped keys)."""
-    keys = jax.random.split(key, images_u8.shape[0])
-    return jax.vmap(_augment_one, in_axes=(0, 0, None))(images_u8, keys, cfg)
+    draw per sample."""
+    return _augment_with_keys(
+        images_u8, _sample_keys(key, 0, images_u8.shape[0]), cfg
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -258,6 +302,46 @@ def two_crops(images_u8: jax.Array, key: jax.Array, cfg: AugConfig):
     the batch sharded P('data'), `concatenate([x, x], 0)` makes GSPMD
     reshard the whole batch across chips every step (measured: 12
     collective-permutes + 20 all-to-alls in the compiled HLO vs ZERO for
-    this form)."""
+    this form). For MULTI-chip meshes with the Pallas blur, use
+    `build_two_crops_sharded` — a pallas_call has no GSPMD partitioning rule
+    and would otherwise be computed on a replicated (all-gathered) batch."""
     kq, kk = jax.random.split(key)
     return augment_batch(images_u8, kq, cfg), augment_batch(images_u8, kk, cfg)
+
+
+def build_two_crops_sharded(cfg: AugConfig, mesh):
+    """`two_crops` as an explicit per-device shard_map program.
+
+    Each device augments only ITS shard of the global batch, deriving
+    per-sample keys from GLOBAL sample indices (`axis_index * local_b + i`),
+    so the output equals the unsharded `two_crops` exactly — while every op,
+    including the Pallas blur kernel, runs purely device-local (no
+    collectives, no replicated batch)."""
+    from jax.sharding import PartitionSpec as P
+
+    from moco_tpu.parallel.mesh import DATA_AXIS
+
+    if jax.default_backend() != "tpu" and cfg.pallas_blur != "off":
+        # interpret-mode pallas cannot run inside a shard_map region in this
+        # jax version (vma mismatch in the discharged jaxpr); the portable
+        # blur is equivalent (tests/test_pallas_blur.py) so use it off-TPU
+        cfg = cfg._replace(pallas_blur="off")
+
+    def body(imgs, key):
+        local_b = imgs.shape[0]
+        start = jax.lax.axis_index(DATA_AXIS) * local_b
+        kq, kk = jax.random.split(key)
+
+        def crop(k):
+            return _augment_with_keys(imgs, _sample_keys(k, start, local_b), cfg)
+
+        return crop(kq), crop(kk)
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P()),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        )
+    )

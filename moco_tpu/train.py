@@ -24,8 +24,8 @@ from moco_tpu.checkpoint import checkpoint_manager, maybe_resume, save_checkpoin
 from moco_tpu.config import PRESETS, PretrainConfig, get_preset
 from moco_tpu.data import (
     build_dataset,
+    build_two_crops_sharded,
     epoch_loader,
-    two_crops,
     v1_aug_config,
     v2_aug_config,
 )
@@ -133,6 +133,7 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
         else v1_aug_config(config.image_size)
     )
     data_key = jax.random.key(config.seed + 1)
+    two_crops_fn = build_two_crops_sharded(aug_cfg, mesh)
 
     # host-side step counter mirroring state.step: int(state.step) would be a
     # device→host sync (~70 ms on the relay) serializing every iteration
@@ -173,7 +174,7 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
                         break
                     data_time.update(time.perf_counter() - end)
                     step_key = jax.random.fold_in(data_key, global_step)
-                    im_q, im_k = two_crops(imgs, step_key, aug_cfg)
+                    im_q, im_k = two_crops_fn(imgs, step_key)
                     profiler.maybe_toggle(global_step)
                     state, metrics = step_fn(state, im_q, im_k)
                     global_step += 1

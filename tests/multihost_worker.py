@@ -1,0 +1,91 @@
+"""Worker for the 2-process multi-host simulation test (SURVEY §4 item 4).
+
+Launched by tests/test_multihost.py as:
+    python tests/multihost_worker.py <coordinator> <num_procs> <pid> <ckpt_dir>
+
+Each process owns 4 fake CPU devices → a global 8-device data mesh across 2
+"hosts". Runs 3 steps of the real v1 train step with the real host-sharded
+input path, saves a collective Orbax checkpoint, and prints digests of the
+replicated state — the parent asserts both processes agree bit-for-bit.
+"""
+
+import hashlib
+import sys
+
+import numpy as np
+
+
+def main():
+    coordinator, num_procs, pid, ckpt_dir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+    )
+    import os
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from moco_tpu.parallel.mesh import distributed_init
+
+    distributed_init(coordinator, num_procs, pid)
+    assert jax.process_count() == num_procs
+    assert len(jax.devices()) == 4 * num_procs, jax.devices()
+
+    import jax.numpy as jnp
+
+    from moco_tpu.checkpoint import checkpoint_manager, save_checkpoint
+    from moco_tpu.config import PretrainConfig
+    from moco_tpu.data.datasets import SyntheticDataset
+    from moco_tpu.data.loader import epoch_loader
+    from moco_tpu.parallel.mesh import create_mesh
+    from moco_tpu.train_state import create_train_state
+    from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
+
+    GLOBAL_B, IMG, DIM, K = 16, 8, 16, 64
+    config = PretrainConfig(
+        variant="v1", arch="resnet_tiny", cifar_stem=True, num_negatives=K,
+        embed_dim=DIM, batch_size=GLOBAL_B, epochs=1, lr=0.1, seed=0,
+    )
+    mesh = create_mesh()
+    model = build_encoder(config)
+    tx, sched = build_optimizer(config, 4)
+    state = create_train_state(
+        jax.random.key(0), model, tx, (GLOBAL_B // 8, IMG, IMG, 3), K, DIM
+    )
+    step_fn = build_train_step(config, model, tx, mesh, 4, sched)
+
+    dataset = SyntheticDataset(num_samples=64, image_size=IMG, seed=0)
+    loader = epoch_loader(dataset, epoch=0, seed=0, global_batch=GLOBAL_B, mesh=mesh)
+    steps = 0
+    try:
+        for imgs, _labels in loader:
+            imgs_f32 = imgs.astype(jnp.float32)
+            state, metrics = step_fn(state, imgs_f32, imgs_f32)
+            steps += 1
+            if steps == 3:
+                break
+    finally:
+        loader.close()
+
+    mgr = checkpoint_manager(ckpt_dir)
+    save_checkpoint(mgr, state, steps)  # collective: every process calls it
+    mgr.wait_until_finished()
+
+    # digest the fully-replicated state from THIS process's local shard
+    def digest(x):
+        local = np.asarray(x.addressable_shards[0].data)
+        return hashlib.sha256(np.ascontiguousarray(local).tobytes()).hexdigest()[:16]
+
+    print(
+        f"RESULT pid={pid} steps={steps} loss={float(metrics['loss']):.6f} "
+        f"queue={digest(state.queue)} ptr={int(state.queue_ptr)} "
+        f"conv1={digest(state.params_q['conv1']['kernel'])}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
