@@ -186,13 +186,72 @@ def export_encoder_q(
         mlp_head=mlp_head,
         prefix=prefix,
     )
+    _save_flat(flat, path)
+    return flat
+
+
+def flatten_tree(tree, prefix: str = "") -> dict[str, np.ndarray]:
+    """Generic `a/b/c`-joined flattening (the export dialect for backbones
+    with no torchvision equivalent, e.g. the v3 ViT)."""
+    out: dict[str, np.ndarray] = {}
+    for name, sub in tree.items():
+        key = f"{prefix}{name}"
+        if isinstance(sub, dict):
+            out.update(flatten_tree(sub, key + "/"))
+        else:
+            out[key] = np.ascontiguousarray(np.asarray(sub))
+    return out
+
+
+def unflatten_tree(flat: dict[str, np.ndarray], prefix: str = "") -> dict:
+    tree: dict = {}
+    for name, arr in flat.items():
+        if not name.startswith(prefix):
+            continue
+        parts = name[len(prefix):].split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return tree
+
+
+def _save_flat(flat: dict[str, np.ndarray], path: str) -> None:
+    """One writer for both export dialects (npz by extension, else safetensors)."""
     if path.endswith(".npz"):
         np.savez(path, **flat)
     else:
         from safetensors.numpy import save_file
 
         save_file(flat, path)
+
+
+def export_v3_backbone(state: TrainState, path: str) -> dict[str, np.ndarray]:
+    """Export a MoCo-v3 query BACKBONE (predictor/projector dropped — the v3
+    lincls protocol probes backbone features) in the `a/b/c` dialect with a
+    `v3_backbone/` prefix; plus `v3_backbone_stats/` for any BN stats."""
+    flat = flatten_tree(
+        jax.tree.map(np.asarray, state.params_q["backbone"]), "v3_backbone/"
+    )
+    stats = state.batch_stats_q.get("backbone", {})
+    if stats:
+        flat.update(
+            flatten_tree(jax.tree.map(np.asarray, stats), "v3_backbone_stats/")
+        )
+    _save_flat(flat, path)
     return flat
+
+
+def load_pretrained_backbone(path: str) -> tuple[dict, dict]:
+    """Dialect-routed load of a pretrained backbone: torchvision
+    `module.encoder_q.*` (v1/v2, head dropped) or `v3_backbone/*` trees.
+    Returns (params, batch_stats) as numpy trees."""
+    flat = import_encoder_q(path)
+    if any(k.startswith("v3_backbone/") for k in flat):
+        return unflatten_tree(flat, "v3_backbone/"), unflatten_tree(
+            flat, "v3_backbone_stats/"
+        )
+    return torchvision_to_resnet(flat)
 
 
 def import_encoder_q(path: str) -> dict[str, np.ndarray]:

@@ -57,6 +57,7 @@ class PretrainConfig:
     ckpt_dir: str = "checkpoints"
     ckpt_every_epochs: int = 1
     resume: str = ""                  # path | "auto"
+    export_path: str = ""             # write encoder_q (.safetensors/.npz) at end
     steps_per_epoch: int | None = None  # derived from dataset unless set
     knn_monitor: bool = False         # periodic kNN top-1 during pretrain
     num_classes: int = 1000           # dataset classes (kNN/eval only)

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from moco_tpu.checkpoint import import_encoder_q, torchvision_to_resnet
+from moco_tpu.checkpoint import load_pretrained_backbone
 from moco_tpu.config import EvalConfig
 from moco_tpu.data import (
     augment_batch,
@@ -47,15 +47,25 @@ from moco_tpu.utils.meters import AverageMeter, ProgressMeter
 
 
 def load_frozen_backbone(config: EvalConfig):
-    """Backbone (feature mode) + pretrained weights via checkpoint surgery."""
-    model = build_resnet(
-        config.arch, num_classes=None, cifar_stem=config.cifar_stem
-    )
-    flat = import_encoder_q(config.pretrained)
-    params, stats = torchvision_to_resnet(flat)
+    """Backbone (feature mode) + pretrained weights via checkpoint surgery.
+
+    Accepts both checkpoint dialects: `module.encoder_q.*` torchvision names
+    (v1/v2 ResNet exports and reference-style checkpoints) and the
+    `v3_backbone/*` tree dialect (v3 ViT/ResNet backbones, whose probe
+    protocol likewise drops projector+predictor)."""
+    if config.arch.startswith("vit"):
+        from moco_tpu.models.vit import build_vit
+
+        model = build_vit(config.arch, num_classes=None)
+    else:
+        model = build_resnet(
+            config.arch, num_classes=None, cifar_stem=config.cifar_stem
+        )
+    params, stats = load_pretrained_backbone(config.pretrained)
     if not params:
         raise ValueError(
-            f"no 'module.encoder_q.*' entries found in {config.pretrained!r}"
+            f"no 'module.encoder_q.*' / 'v3_backbone/*' entries found in "
+            f"{config.pretrained!r}"
         )
     # the reference asserts missing_keys == {fc.weight, fc.bias}; here the
     # equivalent check is that the surgery yields exactly the backbone tree
@@ -153,11 +163,13 @@ def validate(eval_step, fc, params, stats, dataset, config: EvalConfig, mesh) ->
 
 def sanity_check(params_after, params_pretrained) -> None:
     """Backbone must be untouched after probe training
-    (`main_lincls.py:≈L390-415`)."""
-    for (pa, a), (pb, b) in zip(
-        jax.tree_util.tree_leaves_with_path(params_after),
-        jax.tree_util.tree_leaves_with_path(params_pretrained),
-    ):
+    (`main_lincls.py:≈L390-415`). strict zip: an empty or mismatched reload
+    must fail loudly, not silently compare nothing."""
+    leaves_after = jax.tree_util.tree_leaves_with_path(params_after)
+    leaves_ref = jax.tree_util.tree_leaves_with_path(params_pretrained)
+    if not leaves_ref:
+        raise AssertionError("sanity_check got an empty pretrained tree")
+    for (pa, a), (pb, b) in zip(leaves_after, leaves_ref, strict=True):
         if not np.array_equal(np.asarray(a), np.asarray(b)):
             raise AssertionError(
                 f"backbone weight changed during linear probe: {jax.tree_util.keystr(pa)}"
@@ -236,7 +248,7 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
     # reference `sanity_check`: reload the pretrain checkpoint from disk and
     # compare (in this functional design the backbone is structurally
     # immutable, but the check still guards against buffer aliasing bugs)
-    reloaded, _ = torchvision_to_resnet(import_encoder_q(config.pretrained))
+    reloaded, _ = load_pretrained_backbone(config.pretrained)
     sanity_check(backbone_params, reloaded)
     return fc, best_acc1
 

@@ -226,6 +226,19 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
         writer.close()
     if mgr is not None:
         mgr.wait_until_finished()
+    if config.export_path and is_main:
+        # close the pretrain→probe loop: v1/v2 write the query encoder in the
+        # reference checkpoint dialect (torchvision names) for evals.lincls /
+        # evals.knn / export_detectron2; v3 writes its backbone tree dialect
+        if config.variant == "v3":
+            from moco_tpu.checkpoint import export_v3_backbone
+
+            export_v3_backbone(state, config.export_path)
+        else:
+            from moco_tpu.checkpoint import export_encoder_q
+
+            export_encoder_q(state, config.export_path)
+        print(f"exported encoder -> {config.export_path}", flush=True)
     return state, last_metrics
 
 

@@ -1,0 +1,50 @@
+"""CIFAR-10 pickle-layout reader test against generated batch files."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from moco_tpu.data.datasets import CIFAR10
+
+
+@pytest.fixture(scope="module")
+def cifar_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("cifar")
+    d = root / "cifar-10-batches-py"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    for name, n in [(f"data_batch_{i}", 20) for i in range(1, 6)] + [("test_batch", 10)]:
+        data = rng.randint(0, 256, (n, 3072), dtype=np.uint8)
+        labels = rng.randint(0, 10, n).tolist()
+        with open(d / name, "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+    return str(root)
+
+
+def test_train_split_concatenates_batches(cifar_dir):
+    ds = CIFAR10(cifar_dir, train=True)
+    assert len(ds) == 100
+    imgs, labels = ds.get_batch(np.arange(8))
+    assert imgs.shape == (8, 32, 32, 3) and imgs.dtype == np.uint8
+    assert labels.shape == (8,)
+    assert ds.num_classes == 10
+
+
+def test_chw_to_hwc_layout(cifar_dir):
+    """CIFAR stores rows as [3072] = [3, 32, 32] planar; reader must emit HWC."""
+    ds = CIFAR10(cifar_dir, train=True)
+    with open(os.path.join(cifar_dir, "cifar-10-batches-py", "data_batch_1"), "rb") as f:
+        raw = pickle.load(f, encoding="bytes")[b"data"][0].reshape(3, 32, 32)
+    np.testing.assert_array_equal(ds.images[0], raw.transpose(1, 2, 0))
+
+
+def test_test_split(cifar_dir):
+    ds = CIFAR10(cifar_dir, train=False)
+    assert len(ds) == 10
+
+
+def test_missing_batch_errors(tmp_path):
+    with pytest.raises(FileNotFoundError, match="cifar-10-batches-py"):
+        CIFAR10(str(tmp_path))

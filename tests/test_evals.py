@@ -65,3 +65,36 @@ def test_knn_eval_end_to_end(exported_ckpt):
     config = eval_config(exported_ckpt, knn_k=20)
     acc = run_knn(config)
     assert acc > 0.15, f"kNN top-1 {acc} not above chance"
+
+
+def test_v3_backbone_dialect_roundtrip(tmp_path):
+    """v3 export (backbone tree dialect, projector/predictor dropped) loads
+    back through the same lincls surgery path — for ResNet AND ViT-style
+    backbones (same code path; ResNetTiny keeps the test fast)."""
+    from moco_tpu.checkpoint import export_v3_backbone, flatten_tree, unflatten_tree
+    from moco_tpu.v3_step import V3Model, create_v3_train_state
+
+    model = V3Model(
+        ResNetTiny(num_classes=None, cifar_stem=True), embed_dim=16, hidden_dim=32
+    )
+    tx = optax.sgd(0.1)
+    state = create_v3_train_state(jax.random.key(0), model, tx, (2, 16, 16, 3))
+    path = str(tmp_path / "v3_backbone.safetensors")
+    flat = export_v3_backbone(state, path)
+    assert all(k.startswith(("v3_backbone/", "v3_backbone_stats/")) for k in flat)
+    assert not any("projector" in k or "predictor" in k for k in flat)
+
+    config = eval_config(path)
+    m, params, stats = load_frozen_backbone(config)
+    for a, b in zip(
+        jax.tree.leaves(params), jax.tree.leaves(state.params_q["backbone"])
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # unflatten(flatten(x)) == x
+    tree = {"a": {"b": np.ones((2, 2)), "c": np.zeros(3)}, "d": np.arange(4)}
+    back = unflatten_tree(flatten_tree(tree))
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(back),
+        jax.tree_util.tree_leaves_with_path(tree),
+    ):
+        np.testing.assert_array_equal(a, b)

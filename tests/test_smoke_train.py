@@ -1,17 +1,22 @@
 """Integration smoke (SURVEY §4 item 3, BASELINE config-1 criterion): run the
-REAL train() driver end-to-end on clusterable synthetic data and assert the
-contrastive loss falls and kNN beats chance. Uses the micro arch so the
-single-core CPU sandbox finishes in ~a minute."""
+REAL train() driver end-to-end on clusterable synthetic data, then feed its
+exported checkpoint through the real linear-probe and kNN eval drivers — the
+complete user journey. Uses the micro arch so the single-core CPU sandbox
+finishes in a couple of minutes."""
+
+import os
 
 import numpy as np
 import pytest
 
-from moco_tpu.config import get_preset
+from moco_tpu.config import EvalConfig, get_preset
 from moco_tpu.train import train
 
 
-@pytest.mark.slow
-def test_moco_v1_smoke_loss_falls_knn_above_chance(mesh8, tmp_path):
+@pytest.fixture(scope="module")
+def trained(mesh8, tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("smoke")
+    export = str(tmp_path / "encoder_q.safetensors")
     config = get_preset("cifar10-moco-v1").replace(
         arch="resnet_tiny",
         dataset="synthetic",
@@ -25,21 +30,55 @@ def test_moco_v1_smoke_loss_falls_knn_above_chance(mesh8, tmp_path):
         knn_monitor=True,
         ckpt_dir=str(tmp_path / "ckpt"),
         tb_dir=str(tmp_path / "tb"),
+        export_path=export,
         print_freq=8,
         num_classes=10,
     )
     state, metrics = train(config, mesh8)
+    return config, state, metrics, export, tmp_path
+
+
+@pytest.mark.slow
+def test_moco_v1_smoke_loss_falls_knn_above_chance(trained):
+    config, state, metrics, export, tmp_path = trained
     assert int(state.step) == 48
+    assert np.isfinite(metrics["loss"])
+    # 10-class synthetic data: chance = 10%; the features must beat it well
+    assert metrics["knn_top1"] > 0.2, f"kNN top-1 {metrics['knn_top1']} not above chance"
+    assert os.path.exists(export)
     try:
         import tensorboardX  # noqa: F401  (optional dep; writer no-ops without it)
     except ImportError:
         pass
     else:
-        import os
-
         tb_files = os.listdir(tmp_path / "tb")
         assert any("tfevents" in f for f in tb_files), tb_files
-    # loss fell below the trivial-collapse plateau and is finite
-    assert np.isfinite(metrics["loss"])
-    # 10-class synthetic data: chance = 10%; the features must beat it well
-    assert metrics["knn_top1"] > 0.2, f"kNN top-1 {metrics['knn_top1']} not above chance"
+
+
+@pytest.mark.slow
+def test_lincls_on_trained_export(trained, mesh8):
+    """Probe on PRETRAINED features must beat chance comfortably — the full
+    pretrain→export→surgery→probe pipeline (config 4 on config 1's output)."""
+    from moco_tpu.evals.lincls import train_lincls
+
+    config, state, metrics, export, tmp_path = trained
+    eval_cfg = EvalConfig().replace(
+        arch="resnet_tiny", pretrained=export, dataset="synthetic",
+        image_size=16, cifar_stem=True, num_classes=10, batch_size=64,
+        epochs=1, lr=1.0, print_freq=8,
+    )
+    fc, best_acc1 = train_lincls(eval_cfg, mesh8, max_steps=24)
+    assert best_acc1 > 30.0, f"probe on pretrained features only {best_acc1}%"
+
+
+@pytest.mark.slow
+def test_knn_on_trained_export(trained):
+    from moco_tpu.evals.knn import run_knn
+
+    config, state, metrics, export, tmp_path = trained
+    eval_cfg = EvalConfig().replace(
+        arch="resnet_tiny", pretrained=export, dataset="synthetic",
+        image_size=16, cifar_stem=True, num_classes=10, knn_k=20,
+    )
+    acc = run_knn(eval_cfg)
+    assert acc > 0.5, f"kNN on pretrained features only {acc}"
