@@ -226,30 +226,46 @@ def _save_flat(flat: dict[str, np.ndarray], path: str) -> None:
         save_file(flat, path)
 
 
-def export_v3_backbone(state: TrainState, path: str) -> dict[str, np.ndarray]:
-    """Export a MoCo-v3 query BACKBONE (predictor/projector dropped — the v3
-    lincls protocol probes backbone features) in the `a/b/c` dialect with a
-    `v3_backbone/` prefix; plus `v3_backbone_stats/` for any BN stats."""
-    flat = flatten_tree(
-        jax.tree.map(np.asarray, state.params_q["backbone"]), "v3_backbone/"
-    )
-    stats = state.batch_stats_q.get("backbone", {})
-    if stats:
+def export_backbone_tree(
+    params: dict, batch_stats: dict, path: str
+) -> dict[str, np.ndarray]:
+    """Export an arbitrary backbone tree (no torchvision equivalent — ViT
+    encoders, v3 backbones) in the `backbone/a/b/c` dialect, with
+    `backbone_stats/` for BN running stats."""
+    flat = flatten_tree(jax.tree.map(np.asarray, params), "backbone/")
+    if batch_stats:
         flat.update(
-            flatten_tree(jax.tree.map(np.asarray, stats), "v3_backbone_stats/")
+            flatten_tree(jax.tree.map(np.asarray, batch_stats), "backbone_stats/")
         )
     _save_flat(flat, path)
     return flat
 
 
+def export_v3_backbone(state: TrainState, path: str) -> dict[str, np.ndarray]:
+    """MoCo-v3 query BACKBONE export (predictor/projector dropped — the v3
+    lincls protocol probes backbone features)."""
+    return export_backbone_tree(
+        state.params_q["backbone"],
+        state.batch_stats_q.get("backbone", {}),
+        path,
+    )
+
+
+def export_vit_encoder(state: TrainState, path: str) -> dict[str, np.ndarray]:
+    """v1/v2 export for ViT encoders (contrastive `head` dropped; ViT has no
+    torchvision dialect, so it uses the tree dialect)."""
+    params = {k: v for k, v in state.params_q.items() if k != "head"}
+    return export_backbone_tree(params, state.batch_stats_q, path)
+
+
 def load_pretrained_backbone(path: str) -> tuple[dict, dict]:
     """Dialect-routed load of a pretrained backbone: torchvision
-    `module.encoder_q.*` (v1/v2, head dropped) or `v3_backbone/*` trees.
-    Returns (params, batch_stats) as numpy trees."""
+    `module.encoder_q.*` (v1/v2 ResNet, head dropped) or `backbone/*` trees
+    (ViT / v3). Returns (params, batch_stats) as numpy trees."""
     flat = import_encoder_q(path)
-    if any(k.startswith("v3_backbone/") for k in flat):
-        return unflatten_tree(flat, "v3_backbone/"), unflatten_tree(
-            flat, "v3_backbone_stats/"
+    if any(k.startswith("backbone/") for k in flat):
+        return unflatten_tree(flat, "backbone/"), unflatten_tree(
+            flat, "backbone_stats/"
         )
     return torchvision_to_resnet(flat)
 

@@ -28,6 +28,9 @@ class PretrainConfig:
     temperature: float = 0.07         # --moco-t (v2 runs use 0.2)
     mlp_head: bool = False            # --mlp
     cifar_stem: bool = False
+    shuffle_mode: str = "permute"     # ShuffleBN flavor: "permute" (faithful
+                                      # all-gather + shared-RNG perm) | "ring"
+                                      # (single ppermute rotation, cheaper)
     compute_dtype: str = "float32"    # "bfloat16" on TPU
     sync_bn: bool = False             # per-device BN is the MoCo default
     # data

@@ -82,11 +82,34 @@ def batch_unshuffle(x: jax.Array, perm: jax.Array, axis_name: str) -> jax.Array:
     return jnp.take(x_all, local_idx, axis=0)
 
 
-def ring_shuffle(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
-    """Cheaper ShuffleBN variant: rotate whole local batches around the ring
-    with a single `ppermute` (SURVEY §2.11 notes this decorrelates BN groups
-    at a fraction of the cost of gather+permute; the all-gather version above
-    stays the semantically faithful default). Self-inverse via `-shift`."""
+def ring_shuffle(x: jax.Array, axis_name: str, inverse: bool = False) -> jax.Array:
+    """Cheaper ShuffleBN variant: HALF-SHARD ring roll via two `ppermute`s.
+
+    Rotating WHOLE local batches would be a functional no-op for ShuffleBN —
+    BN statistics depend only on group MEMBERSHIP, and moving an intact
+    group to another device leaves its composition (and thus the q↔k batch
+    signature MoCo guards against) unchanged. Instead each device's new
+    group is [tail half of shard i-2, head half of shard i-1]: every key-side
+    BN group mixes samples from TWO different query-side groups and every
+    query group is split across two key groups — partial decorrelation at
+    2 half-shard ppermutes instead of a full all-gather. The gather+permute
+    `batch_shuffle` stays the semantically faithful default
+    (`shuffle_mode="permute"`).
+    """
     n = lax.axis_size(axis_name)
-    pairs = [(i, (i + shift) % n) for i in range(n)]
-    return lax.ppermute(x, axis_name, perm=pairs)
+    if x.shape[0] % 2:
+        raise ValueError("ring_shuffle requires an even local batch")
+    h = x.shape[0] // 2
+    if h == 0 or n == 1:
+        return x
+    head, tail = x[:h], x[h:]
+    if not inverse:
+        # shuffled_i = [tail_{i-2}, head_{i-1}]
+        recv_tail = lax.ppermute(tail, axis_name, [(i, (i + 2) % n) for i in range(n)])
+        recv_head = lax.ppermute(head, axis_name, [(i, (i + 1) % n) for i in range(n)])
+        return jnp.concatenate([recv_tail, recv_head], axis=0)
+    # inverse: device j's tail sits as part 0 on device j+2, its head as
+    # part 1 on device j+1
+    back_tail = lax.ppermute(head, axis_name, [(i, (i - 2) % n) for i in range(n)])
+    back_head = lax.ppermute(tail, axis_name, [(i, (i - 1) % n) for i in range(n)])
+    return jnp.concatenate([back_head, back_tail], axis=0)

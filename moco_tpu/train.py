@@ -234,6 +234,10 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
             from moco_tpu.checkpoint import export_v3_backbone
 
             export_v3_backbone(state, config.export_path)
+        elif config.arch.startswith("vit"):
+            from moco_tpu.checkpoint import export_vit_encoder
+
+            export_vit_encoder(state, config.export_path)
         else:
             from moco_tpu.checkpoint import export_encoder_q
 

@@ -150,6 +150,8 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
     construction the lr optax applies. If omitted it is re-derived here with
     this call's `steps_per_epoch`.
     """
+    if config.shuffle_mode not in ("permute", "ring"):
+        raise ValueError(f"unknown shuffle_mode {config.shuffle_mode!r}")
     if config.variant == "v3":
         from moco_tpu.v3_step import build_v3_train_step
 
@@ -162,7 +164,16 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
 
     def spmd_region(params_q, params_k, stats_q, stats_k, queue, im_q, im_k, key):
         # --- ShuffleBN: decorrelate per-device BN groups on the key path ---
-        im_k_shuf, perm = batch_shuffle(im_k, key, DATA_AXIS)
+        # "permute" = the reference-faithful all-gather + shared-RNG global
+        # permutation; "ring" = half-shard roll (2 ppermutes, partial
+        # decorrelation — see collectives.ring_shuffle for why whole-shard
+        # rotation would be a no-op)
+        if config.shuffle_mode == "ring":
+            from moco_tpu.parallel.collectives import ring_shuffle
+
+            im_k_shuf = ring_shuffle(im_k, DATA_AXIS)
+        else:
+            im_k_shuf, perm = batch_shuffle(im_k, key, DATA_AXIS)
         k, mut_k = model.apply(
             {"params": params_k, "batch_stats": stats_k},
             im_k_shuf,
@@ -170,7 +181,10 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
             mutable=["batch_stats"],
         )
         k = l2_normalize(k)
-        k = batch_unshuffle(k, perm, DATA_AXIS)
+        if config.shuffle_mode == "ring":
+            k = ring_shuffle(k, DATA_AXIS, inverse=True)
+        else:
+            k = batch_unshuffle(k, perm, DATA_AXIS)
         k = lax.stop_gradient(k)  # the reference's no_grad key path
 
         def loss_fn(pq):

@@ -63,23 +63,32 @@ def test_all_gather_batch_concatenates_in_rank_order(mesh8):
 
 
 def test_ring_shuffle_roundtrip(mesh8):
-    x = np.arange(16, dtype=np.float32).reshape(16, 1)
+    x = np.arange(32, dtype=np.float32).reshape(32, 1)
 
     def f(x):
-        y = ring_shuffle(x, DATA_AXIS, shift=3)
-        return ring_shuffle(y, DATA_AXIS, shift=-3)
+        y = ring_shuffle(x, DATA_AXIS)
+        return ring_shuffle(y, DATA_AXIS, inverse=True)
 
     out = _shard_map(f, mesh8, (P(DATA_AXIS),), P(DATA_AXIS))(x)
     np.testing.assert_array_equal(np.asarray(out), x)
 
 
-def test_ring_shuffle_moves_every_group(mesh8):
-    x = np.arange(16, dtype=np.float32).reshape(16, 1)
+def test_ring_shuffle_mixes_group_membership(mesh8):
+    """The point of ShuffleBN is changing group COMPOSITION, not which
+    device computes a group: every post-shuffle BN group must contain
+    samples from (at least) two different pre-shuffle groups — a whole-shard
+    rotation would fail this (membership preserved ⇒ BN leak intact)."""
+    x = np.arange(32, dtype=np.float32).reshape(32, 1)
     out = np.asarray(
         _shard_map(
-            lambda x: ring_shuffle(x, DATA_AXIS, 1), mesh8, (P(DATA_AXIS),), P(DATA_AXIS)
+            lambda x: ring_shuffle(x, DATA_AXIS), mesh8, (P(DATA_AXIS),), P(DATA_AXIS)
         )(x)
     )
-    orig = x.reshape(8, 2)
-    new = out.reshape(8, 2)
-    assert all(not np.array_equal(orig[d], new[d]) for d in range(8))
+    orig_groups = [set(g.ravel()) for g in x.reshape(8, 4)]
+    for d in range(8):
+        new_group = set(out.reshape(8, 4)[d].ravel())
+        sources = {
+            i for i, og in enumerate(orig_groups) if og & new_group
+        }
+        assert len(sources) >= 2, f"group {d} drawn from a single source {sources}"
+        assert new_group != orig_groups[d]

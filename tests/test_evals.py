@@ -81,13 +81,15 @@ def test_v3_backbone_dialect_roundtrip(tmp_path):
     state = create_v3_train_state(jax.random.key(0), model, tx, (2, 16, 16, 3))
     path = str(tmp_path / "v3_backbone.safetensors")
     flat = export_v3_backbone(state, path)
-    assert all(k.startswith(("v3_backbone/", "v3_backbone_stats/")) for k in flat)
+    assert all(k.startswith(("backbone/", "backbone_stats/")) for k in flat)
     assert not any("projector" in k or "predictor" in k for k in flat)
 
     config = eval_config(path)
     m, params, stats = load_frozen_backbone(config)
     for a, b in zip(
-        jax.tree.leaves(params), jax.tree.leaves(state.params_q["backbone"])
+        jax.tree.leaves(params),
+        jax.tree.leaves(state.params_q["backbone"]),
+        strict=True,
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # unflatten(flatten(x)) == x

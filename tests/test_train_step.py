@@ -131,6 +131,21 @@ def test_single_device_mesh_same_program(setup):
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_ring_shuffle_mode(setup, mesh8):
+    """shuffle_mode='ring' (SURVEY §2.11 ppermute variant) must run the full
+    step with finite loss and keep the queue semantics identical."""
+    config, model, tx, state, step_fn, batches = setup
+    ring_cfg = config.replace(shuffle_mode="ring")
+    fn = build_train_step(ring_cfg, model, tx, mesh8, steps_per_epoch=4)
+    s, metrics = fn(jax.tree.map(jnp.copy, state), *batches[0])
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(s.queue_ptr) == GLOBAL_B % K
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown shuffle_mode"):
+        build_train_step(config.replace(shuffle_mode="nope"), model, tx, mesh8, 4)
+
+
 def test_lr_follows_step_schedule(setup):
     """Milestone schedule (2,3) with 4 steps/epoch: lr drops x0.1 at epoch 2."""
     config, model, tx, state, step_fn, batches = setup
