@@ -18,7 +18,6 @@ Reference behavior being reproduced:
 from __future__ import annotations
 
 import os
-from typing import Any
 
 import jax
 import numpy as np

@@ -23,7 +23,6 @@ over the data mesh — no parameter-freezing machinery needed.
 from __future__ import annotations
 
 import argparse
-import time
 
 import jax
 import jax.numpy as jnp

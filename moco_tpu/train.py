@@ -258,12 +258,22 @@ def main(argv=None):
     parser.add_argument("--num-devices", type=int, default=None)
     parser.add_argument("--fake-devices", type=int, default=0,
                         help="force N fake CPU devices (testing)")
+    parser.add_argument("--multihost", action="store_true",
+                        help="call jax.distributed.initialize() (multi-host pods; "
+                             "args auto-detected on Cloud TPU)")
+    parser.add_argument("--coordinator-address", default=None)
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
     add_config_flags(parser, PretrainConfig)
     args = parser.parse_args(argv)
     if args.fake_devices:
         from moco_tpu.parallel.mesh import force_cpu_devices
 
         force_cpu_devices(args.fake_devices)
+    if args.multihost:
+        from moco_tpu.parallel.mesh import distributed_init
+
+        distributed_init(args.coordinator_address, args.num_processes, args.process_id)
     config = get_preset(args.preset).replace(
         **collect_overrides(args, PretrainConfig)
     )

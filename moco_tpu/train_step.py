@@ -25,7 +25,6 @@ arithmetic (zero communication).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax
@@ -44,11 +43,7 @@ from moco_tpu.ops.losses import (
     softmax_cross_entropy,
 )
 from moco_tpu.ops.queue import dequeue_and_enqueue
-from moco_tpu.parallel.collectives import (
-    all_gather_batch,
-    batch_shuffle,
-    batch_unshuffle,
-)
+from moco_tpu.parallel.collectives import batch_shuffle, batch_unshuffle
 from moco_tpu.parallel.mesh import DATA_AXIS
 from moco_tpu.train_state import TrainState
 
