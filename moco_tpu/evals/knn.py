@@ -31,6 +31,7 @@ def encode_dataset(
     batch: int = 256,
     indices: np.ndarray | None = None,
     feature_fn=None,
+    mesh=None,
 ):
     """L2-normalized frozen-encoder features (center-crop transform,
     eval-mode BN) for `dataset` (or a subset via `indices`); the tail chunk
@@ -49,6 +50,17 @@ def encode_dataset(
             )
             return out / jnp.linalg.norm(out, axis=-1, keepdims=True)
 
+    sharding = None
+    if mesh is not None and mesh.size > 1:
+        # multi-chip eval: shard each batch over the data axis so the eval
+        # forward parallelizes under the automatic partitioner (the eval
+        # transform has no blur, so no pallas-partitioning caveats apply);
+        # round the batch up to a mesh multiple so the shards are even
+        from moco_tpu.parallel.mesh import batch_sharded
+
+        sharding = batch_sharded(mesh)
+        batch = ((batch + mesh.size - 1) // mesh.size) * mesh.size
+
     if indices is None:
         indices = np.arange(len(dataset))
     feats, labels = [], []
@@ -58,18 +70,23 @@ def encode_dataset(
         valid = len(idx)
         if valid < batch:
             imgs = np.concatenate([imgs, np.repeat(imgs[-1:], batch - valid, 0)])
-        images = augment_batch(jnp.asarray(imgs), key, cfg)
+        imgs = jnp.asarray(imgs) if sharding is None else jax.device_put(imgs, sharding)
+        images = augment_batch(imgs, key, cfg)
         feats.append(np.asarray(feature_fn(params, stats, images))[:valid])
         labels.append(lbls)
     return np.concatenate(feats), np.concatenate(labels)
 
 
-def run_knn(config: EvalConfig) -> float:
+def run_knn(config: EvalConfig, mesh=None) -> float:
+    from moco_tpu.parallel.mesh import create_mesh
+
+    if mesh is None:
+        mesh = create_mesh()
     model, params, stats = load_frozen_backbone(config)
     train_set = build_dataset(config.dataset, config.data_dir, image_size=config.image_size)
     val_set = _val_split(config)
-    bank, bank_labels = encode_dataset(model, params, stats, train_set, config)
-    queries, qlabels = encode_dataset(model, params, stats, val_set, config)
+    bank, bank_labels = encode_dataset(model, params, stats, train_set, config, mesh=mesh)
+    queries, qlabels = encode_dataset(model, params, stats, val_set, config, mesh=mesh)
     acc = knn_accuracy(
         jnp.asarray(queries),
         jnp.asarray(qlabels),

@@ -142,6 +142,10 @@ def validate(eval_step, fc, params, stats, dataset, config: EvalConfig, mesh) ->
     key = jax.random.key(0)
     n = len(dataset)
     b = config.batch_size
+    from moco_tpu.parallel.mesh import batch_sharded
+
+    # config.batch_size is mesh-divisible (train_lincls checks local_batch_size)
+    sharding = batch_sharded(mesh) if mesh is not None and mesh.size > 1 else None
     c1 = c5 = seen = 0.0
     for start in range(0, n, b):
         idx = np.arange(start, min(start + b, n))
@@ -152,7 +156,8 @@ def validate(eval_step, fc, params, stats, dataset, config: EvalConfig, mesh) ->
             # prediction) so every image is scored and shapes stay fixed
             imgs = np.concatenate([imgs, np.repeat(imgs[-1:], b - valid, 0)])
             labels = np.concatenate([labels, np.full(b - valid, -1, labels.dtype)])
-        images = augment_batch(jnp.asarray(imgs), key, cfg)
+        imgs = jnp.asarray(imgs) if sharding is None else jax.device_put(imgs, sharding)
+        images = augment_batch(imgs, key, cfg)
         m = eval_step(fc, params, stats, images, jnp.asarray(labels))
         c1 += float(m["correct1"])
         c5 += float(m["correct5"])
