@@ -33,6 +33,8 @@ class PretrainConfig:
                                       # (single ppermute rotation, cheaper)
     compute_dtype: str = "float32"    # "bfloat16" on TPU
     sync_bn: bool = False             # per-device BN is the MoCo default
+    remat: bool = False               # per-block rematerialization (ViT):
+                                      # trades recompute for HBM at large batch
     # data
     dataset: str = "synthetic"        # synthetic | cifar10 | imagefolder
     data_dir: str = ""

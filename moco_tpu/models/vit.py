@@ -82,6 +82,9 @@ class ViT(nn.Module):
     mlp_ratio: float = 4.0
     num_classes: int | None = None
     frozen_patch_embed: bool = True
+    remat: bool = False   # rematerialize each block (trade FLOPs for HBM —
+                          # lets the v3 large-batch recipe fit; SURVEY §7 /
+                          # scaling-book recipe)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -106,8 +109,9 @@ class ViT(nn.Module):
             "cls_token", nn.initializers.normal(1e-6), (1, 1, self.width), jnp.float32
         )
         x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.width)).astype(self.dtype), x], axis=1)
+        block_cls = nn.remat(TransformerBlock) if self.remat else TransformerBlock
         for i in range(self.depth):
-            x = TransformerBlock(
+            x = block_cls(
                 self.width, self.num_heads, self.mlp_ratio, self.dtype, name=f"block{i}"
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)

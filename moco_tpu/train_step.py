@@ -60,7 +60,9 @@ def build_encoder(config: PretrainConfig):
         if config.arch.startswith("vit"):
             from moco_tpu.models.vit import build_vit
 
-            backbone = build_vit(config.arch, num_classes=None, dtype=dtype)
+            backbone = build_vit(
+                config.arch, num_classes=None, dtype=dtype, remat=config.remat
+            )
         else:
             backbone = build_resnet(
                 config.arch,
@@ -73,7 +75,9 @@ def build_encoder(config: PretrainConfig):
     if config.arch.startswith("vit"):
         from moco_tpu.models.vit import build_vit
 
-        return build_vit(config.arch, num_classes=config.embed_dim, dtype=dtype)
+        return build_vit(
+            config.arch, num_classes=config.embed_dim, dtype=dtype, remat=config.remat
+        )
     return build_resnet(
         config.arch,
         num_classes=config.embed_dim,

@@ -127,6 +127,26 @@ def test_v3_key_params_move_only_by_ema(v3_setup):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+def test_remat_vit_same_params_and_grads():
+    """remat=True must not change the parameter tree or the math — only the
+    memory/recompute trade (it made v3 ViT-S batch 512 compile on the v5e
+    where the non-remat version exhausted compile resources)."""
+    x = jnp.ones((2, IMG, IMG, 3))
+    plain = tiny_vit(num_classes=16)
+    rem = tiny_vit(num_classes=16, remat=True)
+    v = plain.init(jax.random.key(0), x, train=False)
+    v2 = rem.init(jax.random.key(0), x, train=False)
+    assert jax.tree.structure(v) == jax.tree.structure(v2)
+
+    def loss(m, params):
+        return jnp.sum(m.apply({"params": params}, x, train=False) ** 2)
+
+    g1 = jax.grad(lambda p: loss(plain, p))(v["params"])
+    g2 = jax.grad(lambda p: loss(rem, p))(v2["params"])
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2), strict=True):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_v3_resnet_backbone_via_build_encoder(mesh8):
     """v3 also supports ResNet backbones (paper's MoCo v3 R50 recipe)."""
     config = tiny_config(arch="resnet18", cifar_stem=True)
