@@ -190,6 +190,12 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
     train_set = build_dataset(config.dataset, config.data_dir, image_size=config.image_size)
     val_set = _val_split(config)
     model, backbone_params, backbone_stats = load_frozen_backbone(config)
+    # pin the frozen backbone REPLICATED across the mesh once — otherwise the
+    # uncommitted host arrays get re-placed on every jitted step
+    from moco_tpu.parallel.mesh import replicated
+
+    backbone_params = jax.device_put(backbone_params, replicated(mesh))
+    backbone_stats = jax.device_put(backbone_stats, replicated(mesh))
 
     feat_dim = model.apply(
         {"params": backbone_params, "batch_stats": backbone_stats},
