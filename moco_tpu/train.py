@@ -54,7 +54,7 @@ def make_feature_fn(model, variant: str):
     return feature_fn
 
 
-def knn_monitor(config, feature_fn, state, dataset, max_bank: int = 4096) -> float:
+def knn_monitor(config, feature_fn, state, dataset, mesh=None, max_bank: int = 4096) -> float:
     """Periodic kNN top-1 on held-out-ish data (SURVEY §2.5 protocol at
     monitoring scale: embed a train subset as the bank, score a val subset).
     `feature_fn` comes from `make_feature_fn` ONCE per run (recompiling the
@@ -67,11 +67,11 @@ def knn_monitor(config, feature_fn, state, dataset, max_bank: int = 4096) -> flo
     idx = rng.permutation(len(dataset))[:n]
     bank, bank_labels = encode_dataset(
         None, state.params_q, state.batch_stats_q, dataset, config,
-        indices=idx[:split], feature_fn=feature_fn,
+        indices=idx[:split], feature_fn=feature_fn, mesh=mesh,
     )
     val, val_labels = encode_dataset(
         None, state.params_q, state.batch_stats_q, dataset, config,
-        indices=idx[split:], feature_fn=feature_fn,
+        indices=idx[split:], feature_fn=feature_fn, mesh=mesh,
     )
     return knn_accuracy(
         jnp.asarray(val), jnp.asarray(val_labels), jnp.asarray(bank),
@@ -211,7 +211,7 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
                 flush=True,
             )
             if config.knn_monitor:
-                acc = knn_monitor(config, feature_fn, state, dataset)
+                acc = knn_monitor(config, feature_fn, state, dataset, mesh)
                 last_metrics["knn_top1"] = acc
                 print(f"Epoch [{epoch}] kNN top-1 {100 * acc:.2f}%", flush=True)
                 writer.write(global_step, {"knn_top1": acc})
