@@ -122,6 +122,16 @@ PRESETS: dict[str, PretrainConfig | EvalConfig] = {
         knn_monitor=True,
         num_classes=10,
     ),
+    # 0. MoCo-v1 ResNet-50 ImageNet-1k — the reference's DEFAULT run
+    #    (no MLP, no aug+, no cosine; T=0.07, milestones 120/160; the 60.6%
+    #    linear-probe row in BASELINE.md)
+    "imagenet-moco-v1": PretrainConfig(
+        name="imagenet-moco-v1",
+        variant="v1",
+        arch="resnet50",
+        dataset="imagefolder",
+        compute_dtype="bfloat16",
+    ),
     # 2. MoCo-v2 ResNet-50 ImageNet-1k, K=65536, MLP head, cosine LR
     "imagenet-moco-v2": PretrainConfig(
         name="imagenet-moco-v2",

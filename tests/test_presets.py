@@ -1,0 +1,50 @@
+"""Every named preset must construct a valid model + optimizer (shape-level
+only — eval_shape keeps ResNet-50/ViT-S init free)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from moco_tpu.config import PRESETS, PretrainConfig, get_preset
+from moco_tpu.train_step import build_encoder, build_optimizer
+
+
+@pytest.mark.parametrize(
+    "name", [n for n, c in PRESETS.items() if isinstance(c, PretrainConfig)]
+)
+def test_pretrain_preset_builds(name):
+    config = get_preset(name)
+    model = build_encoder(config)
+    tx, sched = build_optimizer(config, steps_per_epoch=100)
+    s = config.image_size
+    kwargs = {"predict": True} if config.variant == "v3" else {}
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0), jnp.zeros((1, s, s, 3)), train=False, **kwargs
+        )
+    )
+    assert "params" in shapes
+    # schedule evaluates finitely at the start/end of training
+    assert float(sched(0)) >= 0.0
+    assert float(sched(100 * config.epochs - 1)) >= 0.0
+
+
+def test_reference_v1_v2_deltas():
+    """The entire v1→v2 delta is 3 flags + temperature (SURVEY §2.1)."""
+    v1 = get_preset("imagenet-moco-v1")
+    v2 = get_preset("imagenet-moco-v2")
+    assert (v1.mlp_head, v1.aug_plus, v1.cos, v1.temperature) == (
+        False, False, False, 0.07,
+    )
+    assert (v2.mlp_head, v2.aug_plus, v2.cos, v2.temperature) == (
+        True, True, True, 0.2,
+    )
+    # everything else identical
+    for field in ("arch", "num_negatives", "momentum_ema", "lr", "batch_size",
+                  "epochs", "weight_decay", "sgd_momentum"):
+        assert getattr(v1, field) == getattr(v2, field), field
+
+
+def test_unknown_preset():
+    with pytest.raises(ValueError, match="unknown preset"):
+        get_preset("nope")
