@@ -17,9 +17,10 @@ Reproduced parameterizations:
   p=.8 + grayscale p=.2 + blur(sigma U(.1,2)) p=.5 + hflip.
 - Normalize with ImageNet mean/std.
 
-Static-shape tricks: the variable-size crop is `jax.image.scale_and_translate`
-(crop+resize in one fixed-shape bilinear op); blur uses a fixed-width
-separable kernel whose WEIGHTS carry the per-sample sigma.
+Static-shape tricks: the variable-size crop is dense-matmul resampling on
+the MXU (`ops/matmul_resize.py`, crop+antialiased-bilinear resize as two
+fixed-shape contractions); blur uses a fixed-width separable kernel whose
+WEIGHTS carry the per-sample sigma.
 """
 
 from __future__ import annotations
@@ -185,7 +186,7 @@ def _gaussian_blur(img, key, cfg: AugConfig):
 
 def _random_resized_crop(img, key, cfg: AugConfig):
     """torchvision RandomResizedCrop semantics (scale=(s0,s1), ratio 3/4..4/3)
-    as a single fixed-shape `scale_and_translate` (crop+bilinear resize)."""
+    as fixed-shape dense-matmul resampling (crop+antialiased bilinear)."""
     h, w = img.shape[0], img.shape[1]
     karea, kaspect, ky, kx = jax.random.split(key, 4)
     area = h * w * jax.random.uniform(
@@ -205,18 +206,12 @@ def _random_resized_crop(img, key, cfg: AugConfig):
     else:
         y0 = jax.random.uniform(ky, (), minval=0.0, maxval=1.0) * (h - ch)
         x0 = jax.random.uniform(kx, (), minval=0.0, maxval=1.0) * (w - cw)
-    s = cfg.out_size
-    scale = jnp.array([s / ch, s / cw])
-    translation = jnp.array([-y0 * s / ch, -x0 * s / cw])
-    return jax.image.scale_and_translate(
-        img,
-        (s, s, img.shape[2]),
-        (0, 1),
-        scale,
-        translation,
-        method="linear",
-        antialias=True,
-    )
+    # crop+resize as two dense matmuls (MXU) instead of gather-based
+    # `scale_and_translate` — measured ~5x faster on the v5e for the same
+    # separable triangle-filter math (see ops/matmul_resize.py)
+    from moco_tpu.ops.matmul_resize import crop_resize
+
+    return crop_resize(img, y0, x0, ch, cw, cfg.out_size, antialias=True)
 
 
 def _random_flip(img, key, cfg: AugConfig):

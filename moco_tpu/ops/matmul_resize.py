@@ -1,0 +1,56 @@
+"""Crop + bilinear resize as two DENSE matmuls (MXU work, not gathers).
+
+`jax.image.scale_and_translate` applies separable interpolation with
+gather-based sampling — measured ~10 ms per 128-image batch on the v5e
+(gather-bound; ~20 ms of a ~79 ms MoCo-v2 step across the two crops). The
+same math is exactly expressible as
+
+    out[c] = Rv @ img[:, :, c] @ Rh^T
+
+with per-sample interpolation matrices `Rv: [S_out, H_src]`,
+`Rh: [S_out, W_src]` whose rows hold the (antialiased) triangle-filter
+weights for one output coordinate. Dense matmuls cost ~170 MFLOP per image —
+noise for the MXU — and vmap batches them straight into bmms.
+
+Weight construction mirrors scale_and_translate's `linear` method: triangle
+kernel, support scaled by the minification factor when `antialias` (PIL
+semantics), weights renormalized over in-bounds taps.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def interp_matrix(
+    src_size: int, out_size: int, crop_start, crop_size, antialias: bool = True
+) -> jax.Array:
+    """[out_size, src_size] row-stochastic interpolation weights mapping the
+    window [crop_start, crop_start + crop_size) onto out_size samples.
+    `crop_start`/`crop_size` may be traced scalars (static shapes)."""
+    scale = crop_size / out_size
+    o = jnp.arange(out_size, dtype=jnp.float32)
+    pos = crop_start + (o + 0.5) * scale - 0.5          # source-space centers
+    idx = jnp.arange(src_size, dtype=jnp.float32)
+    support = jnp.maximum(scale, 1.0) if antialias else jnp.float32(1.0)
+    dist = jnp.abs(pos[:, None] - idx[None, :]) / support
+    w = jnp.clip(1.0 - dist, 0.0, None)
+    return w / jnp.maximum(jnp.sum(w, axis=1, keepdims=True), 1e-8)
+
+
+def crop_resize(
+    img: jax.Array,  # [H, W, C] float32
+    y0,
+    x0,
+    crop_h,
+    crop_w,
+    out_size: int,
+    antialias: bool = True,
+) -> jax.Array:
+    """Resample the box [y0:y0+crop_h, x0:x0+crop_w] to [out, out, C]."""
+    rv = interp_matrix(img.shape[0], out_size, y0, crop_h, antialias)
+    rh = interp_matrix(img.shape[1], out_size, x0, crop_w, antialias)
+    # [O,H]x[H,W,C] then [O,W,C]x[W,O'] — two dense contractions on the MXU
+    tmp = jnp.einsum("oh,hwc->owc", rv, img, preferred_element_type=jnp.float32)
+    return jnp.einsum("pw,owc->opc", rh, tmp, preferred_element_type=jnp.float32)

@@ -1,0 +1,51 @@
+"""Dense-matmul crop/resize op: agreement with jax.image.scale_and_translate
+and basic filter properties."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from moco_tpu.ops.matmul_resize import crop_resize, interp_matrix
+
+
+def test_matches_scale_and_translate():
+    rng = np.random.RandomState(0)
+    img = jnp.asarray(rng.rand(60, 60, 3).astype(np.float32))
+    s = 32
+    for i in range(4):
+        ch, cw = rng.uniform(12, 60), rng.uniform(12, 60)
+        y0, x0 = rng.uniform(0, 60 - ch), rng.uniform(0, 60 - cw)
+        ref = jax.image.scale_and_translate(
+            img, (s, s, 3), (0, 1),
+            jnp.array([s / ch, s / cw]), jnp.array([-y0 * s / ch, -x0 * s / cw]),
+            method="linear", antialias=True,
+        )
+        got = crop_resize(img, y0, x0, ch, cw, s)
+        # small boundary-normalization/convention differences are fine for an
+        # augmentation resampler; the bulk must agree closely
+        assert float(jnp.abs(ref - got).max()) < 2e-2
+        assert float(jnp.abs(ref - got).mean()) < 2e-3
+
+
+def test_interp_matrix_row_stochastic():
+    m = np.asarray(interp_matrix(60, 32, 10.0, 37.5))
+    np.testing.assert_allclose(m.sum(axis=1), 1.0, rtol=1e-5)
+    assert (m >= 0).all()
+
+
+def test_identity_crop_is_near_identity():
+    """Full-image crop at the same resolution ≈ identity mapping."""
+    rng = np.random.RandomState(1)
+    img = jnp.asarray(rng.rand(32, 32, 3).astype(np.float32))
+    out = crop_resize(img, 0.0, 0.0, 32.0, 32.0, 32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(img), atol=1e-5)
+
+
+def test_upscale_and_downscale_ranges():
+    """Resampling must stay within the input's convex hull (weights are a
+    convex combination) for both minification and magnification."""
+    img = jnp.asarray(np.random.RandomState(2).rand(40, 40, 3).astype(np.float32))
+    for ch in (8.0, 40.0):
+        out = np.asarray(crop_resize(img, 0.0, 0.0, ch, ch, 24))
+        assert out.min() >= float(img.min()) - 1e-5
+        assert out.max() <= float(img.max()) + 1e-5
