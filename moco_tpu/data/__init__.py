@@ -6,6 +6,7 @@ from moco_tpu.data.augment import (
     two_crops,
     v1_aug_config,
     v2_aug_config,
+    v3_aug_configs,
 )
 from moco_tpu.data.datasets import CIFAR10, ImageFolder, SyntheticDataset, build_dataset
 from moco_tpu.data.loader import Prefetcher, epoch_loader, epoch_permutation, host_shard
@@ -18,6 +19,7 @@ __all__ = [
     "two_crops",
     "v1_aug_config",
     "v2_aug_config",
+    "v3_aug_configs",
     "CIFAR10",
     "ImageFolder",
     "SyntheticDataset",

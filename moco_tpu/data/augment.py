@@ -51,6 +51,7 @@ class AugConfig(NamedTuple):
     blur_prob: float = 0.0        # v2 uses 0.5
     blur_sigma: tuple[float, float] = (0.1, 2.0)
     flip_prob: float = 0.5
+    solarize_prob: float = 0.0    # v3's second view uses 0.2 (threshold 0.5)
     deterministic: bool = False   # eval: fixed-aspect center crop, no randomness
     pallas_blur: str = "auto"     # auto (TPU only) | on | off — see ops/pallas_blur.py
 
@@ -61,6 +62,21 @@ def v1_aug_config(out_size: int = 224) -> AugConfig:
 
 def v2_aug_config(out_size: int = 224) -> AugConfig:
     return AugConfig(out_size=out_size, hue=0.1, jitter_prob=0.8, blur_prob=0.5)
+
+
+def v3_aug_configs(out_size: int = 224) -> tuple[AugConfig, AugConfig]:
+    """moco-v3's ASYMMETRIC per-view recipes (BYOL-style; sibling repo
+    `main_moco.py` augmentation1/augmentation2): both views use
+    jitter(.4,.4,.2,.1) p=.8 + grayscale .2 + flip, but view 1 always blurs
+    (p=1.0) while view 2 rarely blurs (p=.1) and solarizes (p=.2)."""
+    base = AugConfig(
+        out_size=out_size, min_scale=0.08, saturation=0.2, hue=0.1,
+        jitter_prob=0.8, grayscale_prob=0.2,
+    )
+    return (
+        base._replace(blur_prob=1.0),
+        base._replace(blur_prob=0.1, solarize_prob=0.2),
+    )
 
 
 def eval_aug_config(out_size: int = 224) -> AugConfig:
@@ -214,6 +230,13 @@ def _random_resized_crop(img, key, cfg: AugConfig):
     return crop_resize(img, y0, x0, ch, cw, cfg.out_size, antialias=True)
 
 
+def _random_solarize(img, key, cfg: AugConfig):
+    """Invert pixels above 0.5 (torchvision RandomSolarize(threshold=128))."""
+    apply = jax.random.uniform(key, ()) < cfg.solarize_prob
+    sol = jnp.where(img >= 0.5, 1.0 - img, img)
+    return jnp.where(apply, sol, img)
+
+
 def _random_flip(img, key, cfg: AugConfig):
     apply = jax.random.uniform(key, ()) < cfg.flip_prob
     return jnp.where(apply, img[:, ::-1, :], img)
@@ -221,7 +244,7 @@ def _random_flip(img, key, cfg: AugConfig):
 
 def _augment_one(img_u8, key, cfg: AugConfig, skip_blur: bool = False):
     img = img_u8.astype(jnp.float32) / 255.0
-    kcrop, kjit, kgray, kblur, kflip = jax.random.split(key, 5)
+    kcrop, kjit, kgray, kblur, kflip, ksol = jax.random.split(key, 6)
     img = _random_resized_crop(img, kcrop, cfg)
     if cfg.jitter_prob > 0:
         img = _color_jitter(img, kjit, cfg)
@@ -229,12 +252,19 @@ def _augment_one(img_u8, key, cfg: AugConfig, skip_blur: bool = False):
         img = _random_grayscale(img, kgray, cfg)
     if cfg.blur_prob > 0 and not skip_blur:
         img = _gaussian_blur(img, kblur, cfg)
+    if cfg.solarize_prob > 0:
+        img = _random_solarize(img, ksol, cfg)
     img = _random_flip(img, kflip, cfg)
     return (img - IMAGENET_MEAN) / IMAGENET_STD
 
 
 def _use_pallas_blur(cfg: AugConfig) -> bool:
     if cfg.blur_prob <= 0 or cfg.pallas_blur == "off":
+        return False
+    if cfg.solarize_prob > 0:
+        # the lifted kernel applies blur AFTER the pipeline, which only
+        # commutes with linear ops — solarize is nonlinear, so v3's
+        # solarizing view keeps the in-pipeline (portable) blur
         return False
     if cfg.pallas_blur == "on":
         return True
@@ -269,7 +299,7 @@ def _augment_with_keys(images_u8: jax.Array, keys: jax.Array, cfg: AugConfig) ->
         )
 
         radius = blur_radius(cfg.out_size)
-        kblurs = jax.vmap(lambda k: jax.random.split(k, 5)[3])(keys)
+        kblurs = jax.vmap(lambda k: jax.random.split(k, 6)[3])(keys)
         weights = jax.vmap(
             lambda k: blur_weights(k, radius, cfg.blur_sigma, cfg.blur_prob)
         )(kblurs)
@@ -304,33 +334,41 @@ def two_crops(images_u8: jax.Array, key: jax.Array, cfg: AugConfig):
     return augment_batch(images_u8, kq, cfg), augment_batch(images_u8, kk, cfg)
 
 
-def build_two_crops_sharded(cfg: AugConfig, mesh):
+def build_two_crops_sharded(cfg, mesh):
     """`two_crops` as an explicit per-device shard_map program.
 
     Each device augments only ITS shard of the global batch, deriving
     per-sample keys from GLOBAL sample indices (`axis_index * local_b + i`),
     so the output equals the unsharded `two_crops` exactly — while every op,
     including the Pallas blur kernel, runs purely device-local (no
-    collectives, no replicated batch)."""
+    collectives, no replicated batch).
+
+    `cfg` is one AugConfig (both views identical, v1/v2) or a
+    `(cfg_view1, cfg_view2)` pair (v3's asymmetric blur/solarize recipes)."""
     from jax.sharding import PartitionSpec as P
 
     from moco_tpu.parallel.mesh import DATA_AXIS
 
-    if jax.default_backend() != "tpu" and cfg.pallas_blur != "off":
+    if isinstance(cfg, AugConfig):  # NB: AugConfig IS a tuple — check first
+        cfg_q = cfg_k = cfg
+    else:
+        cfg_q, cfg_k = cfg
+    if jax.default_backend() != "tpu":
         # interpret-mode pallas cannot run inside a shard_map region in this
         # jax version (vma mismatch in the discharged jaxpr); the portable
         # blur is equivalent (tests/test_pallas_blur.py) so use it off-TPU
-        cfg = cfg._replace(pallas_blur="off")
+        cfg_q = cfg_q._replace(pallas_blur="off")
+        cfg_k = cfg_k._replace(pallas_blur="off")
 
     def body(imgs, key):
         local_b = imgs.shape[0]
         start = jax.lax.axis_index(DATA_AXIS) * local_b
         kq, kk = jax.random.split(key)
 
-        def crop(k):
-            return _augment_with_keys(imgs, _sample_keys(k, start, local_b), cfg)
+        def crop(k, c):
+            return _augment_with_keys(imgs, _sample_keys(k, start, local_b), c)
 
-        return crop(kq), crop(kk)
+        return crop(kq, cfg_q), crop(kk, cfg_k)
 
     return jax.jit(
         jax.shard_map(

@@ -28,6 +28,7 @@ from moco_tpu.data import (
     epoch_loader,
     v1_aug_config,
     v2_aug_config,
+    v3_aug_configs,
 )
 from moco_tpu.ops.knn import knn_accuracy
 from moco_tpu.parallel.mesh import create_mesh, local_batch_size
@@ -127,11 +128,12 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
 
         state = jax.device_put(state, replicated(mesh))
 
-    aug_cfg = (
-        v2_aug_config(config.image_size)
-        if config.aug_plus
-        else v1_aug_config(config.image_size)
-    )
+    if config.variant == "v3":
+        aug_cfg = v3_aug_configs(config.image_size)  # asymmetric view pair
+    elif config.aug_plus:
+        aug_cfg = v2_aug_config(config.image_size)
+    else:
+        aug_cfg = v1_aug_config(config.image_size)
     data_key = jax.random.key(config.seed + 1)
     two_crops_fn = build_two_crops_sharded(aug_cfg, mesh)
 

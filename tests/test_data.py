@@ -109,3 +109,33 @@ def test_epoch_loader_yields_sharded_batches(mesh8):
     assert labels.shape == (16,)
     # sharded over the 8 devices, 2 rows each
     assert len(imgs.sharding.device_set) == 8
+
+
+def test_solarize_semantics():
+    from moco_tpu.data.augment import AugConfig, _random_solarize
+    import jax as _jax
+
+    img = jnp.asarray([[[0.2, 0.6, 0.9]]])
+    cfg_on = AugConfig(solarize_prob=1.0)
+    out = np.asarray(_random_solarize(img, _jax.random.key(0), cfg_on))
+    np.testing.assert_allclose(out[0, 0], [0.2, 0.4, 0.1], atol=1e-6)
+    cfg_off = AugConfig(solarize_prob=0.0)
+    out2 = np.asarray(_random_solarize(img, _jax.random.key(0), cfg_off))
+    np.testing.assert_allclose(out2[0, 0], [0.2, 0.6, 0.9], atol=1e-6)
+
+
+def test_v3_asymmetric_two_crops(mesh8):
+    """v3's view pair uses different configs (blur p=1.0 vs p=0.1+solarize);
+    the sharded builder must accept the pair and produce valid crops."""
+    from moco_tpu.data.augment import build_two_crops_sharded, v3_aug_configs
+
+    rng = np.random.RandomState(3)
+    imgs = jnp.asarray(rng.randint(0, 256, (16, 24, 24, 3), dtype=np.uint8))
+    cfg1, cfg2 = v3_aug_configs(out_size=16)
+    assert cfg1.blur_prob == 1.0 and cfg2.blur_prob == 0.1
+    assert cfg1.solarize_prob == 0.0 and cfg2.solarize_prob == 0.2
+    fn = build_two_crops_sharded((cfg1, cfg2), mesh8)
+    q, k = fn(imgs, jax.random.key(0))
+    assert q.shape == k.shape == (16, 16, 16, 3)
+    assert np.isfinite(np.asarray(q)).all() and np.isfinite(np.asarray(k)).all()
+    assert not np.allclose(np.asarray(q), np.asarray(k))
