@@ -95,7 +95,8 @@ class EvalConfig:
     knn_k: int = 200
     knn_temperature: float = 0.07
     print_freq: int = 10
-    ckpt_dir: str = "lincls_checkpoints"
+    ckpt_dir: str = "lincls_checkpoints"  # probe checkpoints ("" = off)
+    resume: str = ""                      # "" | "auto" (latest probe ckpt)
 
     def replace(self, **kw) -> "EvalConfig":
         return dataclasses.replace(self, **kw)

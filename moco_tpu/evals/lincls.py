@@ -227,8 +227,35 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
     key = jax.random.key(config.seed + 1)
     best_acc1 = 0.0
     step = 0
+    start_epoch = 0
     total = max_steps or config.epochs * steps_per_epoch
-    for epoch in range(config.epochs):
+
+    # probe checkpointing (the reference saves fc/optimizer/epoch/best_acc1
+    # every epoch and supports --resume, `main_lincls.py:≈L120-140, L280`)
+    mgr = None
+    if config.ckpt_dir:
+        import orbax.checkpoint as ocp
+
+        from moco_tpu.checkpoint import checkpoint_manager
+
+        mgr = checkpoint_manager(config.ckpt_dir)
+        if config.resume == "auto" and mgr.latest_step() is not None:
+            probe = {"fc": fc, "opt_state": opt_state,
+                     "best_acc1": jnp.zeros(())}
+            restored = mgr.restore(
+                mgr.latest_step(), args=ocp.args.StandardRestore(probe)
+            )
+            fc, opt_state = restored["fc"], restored["opt_state"]
+            # Orbax restores onto device 0; re-place replicated to match the
+            # mesh-replicated backbone
+            from moco_tpu.parallel.mesh import replicated
+
+            fc, opt_state = jax.device_put((fc, opt_state), replicated(mesh))
+            best_acc1 = float(restored["best_acc1"])
+            step = mgr.latest_step()
+            start_epoch = step // steps_per_epoch
+
+    for epoch in range(start_epoch, config.epochs):
         losses = AverageMeter("Loss", ":.4e")
         top1 = AverageMeter("Acc@1", ":6.2f")
         progress = ProgressMeter(steps_per_epoch, [losses, top1], f"Epoch: [{epoch}]")
@@ -253,8 +280,21 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
         best_acc1 = max(best_acc1, acc1)
         print(f"Epoch [{epoch}] val Acc@1 {acc1:.2f} Acc@5 {acc5:.2f} (best {best_acc1:.2f})",
               flush=True)
+        if mgr is not None:
+            import jax.numpy as _jnp
+            import orbax.checkpoint as ocp
+
+            mgr.save(
+                step,
+                args=ocp.args.StandardSave(
+                    {"fc": fc, "opt_state": opt_state,
+                     "best_acc1": _jnp.asarray(best_acc1)}
+                ),
+            )
         if step >= total:
             break
+    if mgr is not None:
+        mgr.wait_until_finished()
     # reference `sanity_check`: reload the pretrain checkpoint from disk and
     # compare (in this functional design the backbone is structurally
     # immutable, but the check still guards against buffer aliasing bugs)

@@ -28,7 +28,7 @@ def eval_config(path, **kw):
     base = dict(
         arch="resnet_tiny", pretrained=path, dataset="synthetic",
         image_size=16, cifar_stem=True, num_classes=10, batch_size=64,
-        epochs=1, lr=1.0, print_freq=4,
+        epochs=1, lr=1.0, print_freq=4, ckpt_dir="",
     )
     base.update(kw)
     return EvalConfig().replace(**base)
@@ -100,3 +100,19 @@ def test_v3_backbone_dialect_roundtrip(tmp_path):
         jax.tree_util.tree_leaves_with_path(tree),
     ):
         np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_lincls_checkpoint_resume(mesh8, exported_ckpt, tmp_path):
+    """Probe checkpointing + --resume auto (the reference's main_lincls
+    saves fc/optimizer/epoch/best every epoch)."""
+    cfg = eval_config(exported_ckpt, ckpt_dir=str(tmp_path / "probe"), epochs=2)
+    fc1, best1 = train_lincls(cfg, mesh8, max_steps=32)
+    import os
+
+    steps = sorted(int(d) for d in os.listdir(tmp_path / "probe"))
+    assert steps, "no probe checkpoints written"
+    # resume: picks up from the saved step and continues without error
+    cfg2 = cfg.replace(resume="auto", epochs=3)
+    fc2, best2 = train_lincls(cfg2, mesh8, max_steps=64)
+    assert best2 >= 0.0

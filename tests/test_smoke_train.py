@@ -65,7 +65,7 @@ def test_lincls_on_trained_export(trained, mesh8):
     eval_cfg = EvalConfig().replace(
         arch="resnet_tiny", pretrained=export, dataset="synthetic",
         image_size=16, cifar_stem=True, num_classes=10, batch_size=64,
-        epochs=1, lr=1.0, print_freq=8,
+        epochs=1, lr=1.0, print_freq=8, ckpt_dir="",
     )
     fc, best_acc1 = train_lincls(eval_cfg, mesh8, max_steps=24)
     assert best_acc1 > 30.0, f"probe on pretrained features only {best_acc1}%"
@@ -78,7 +78,7 @@ def test_knn_on_trained_export(trained):
     config, state, metrics, export, tmp_path = trained
     eval_cfg = EvalConfig().replace(
         arch="resnet_tiny", pretrained=export, dataset="synthetic",
-        image_size=16, cifar_stem=True, num_classes=10, knn_k=20,
+        image_size=16, cifar_stem=True, num_classes=10, knn_k=20, ckpt_dir="",
     )
     acc = run_knn(eval_cfg)
     assert acc > 0.5, f"kNN on pretrained features only {acc}"
