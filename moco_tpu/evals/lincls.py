@@ -232,6 +232,8 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
 
     # probe checkpointing (the reference saves fc/optimizer/epoch/best_acc1
     # every epoch and supports --resume, `main_lincls.py:≈L120-140, L280`)
+    if config.resume and not config.ckpt_dir:
+        raise ValueError("--resume requires a ckpt_dir to resume from")
     mgr = None
     if config.ckpt_dir:
         import orbax.checkpoint as ocp
@@ -248,12 +250,13 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
             fc, opt_state = restored["fc"], restored["opt_state"]
             # Orbax restores onto device 0; re-place replicated to match the
             # mesh-replicated backbone
-            from moco_tpu.parallel.mesh import replicated
-
             fc, opt_state = jax.device_put((fc, opt_state), replicated(mesh))
             best_acc1 = float(restored["best_acc1"])
-            step = mgr.latest_step()
-            start_epoch = step // steps_per_epoch
+            # epoch-granular resume (reference semantics): a mid-epoch save
+            # (max_steps break) resumes from its epoch's START — keeping the
+            # raw saved step would skip data and desync the LR schedule
+            start_epoch = mgr.latest_step() // steps_per_epoch
+            step = start_epoch * steps_per_epoch
 
     for epoch in range(start_epoch, config.epochs):
         losses = AverageMeter("Loss", ":.4e")
@@ -281,14 +284,13 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
         print(f"Epoch [{epoch}] val Acc@1 {acc1:.2f} Acc@5 {acc5:.2f} (best {best_acc1:.2f})",
               flush=True)
         if mgr is not None:
-            import jax.numpy as _jnp
             import orbax.checkpoint as ocp
 
             mgr.save(
                 step,
                 args=ocp.args.StandardSave(
                     {"fc": fc, "opt_state": opt_state,
-                     "best_acc1": _jnp.asarray(best_acc1)}
+                     "best_acc1": jnp.asarray(best_acc1)}
                 ),
             )
         if step >= total:

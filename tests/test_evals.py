@@ -112,7 +112,13 @@ def test_lincls_checkpoint_resume(mesh8, exported_ckpt, tmp_path):
 
     steps = sorted(int(d) for d in os.listdir(tmp_path / "probe"))
     assert steps, "no probe checkpoints written"
-    # resume: picks up from the saved step and continues without error
+    # resume: continues PAST the first run's last checkpoint (a restore
+    # that silently restarted from scratch would stop at the same step)
     cfg2 = cfg.replace(resume="auto", epochs=3)
-    fc2, best2 = train_lincls(cfg2, mesh8, max_steps=64)
-    assert best2 >= 0.0
+    fc2, best2 = train_lincls(cfg2, mesh8, max_steps=96)
+    steps2 = sorted(int(d) for d in os.listdir(tmp_path / "probe"))
+    assert max(steps2) > max(steps), (steps, steps2)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="requires a ckpt_dir"):
+        train_lincls(cfg.replace(ckpt_dir="", resume="auto"), mesh8, max_steps=1)
