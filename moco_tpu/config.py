@@ -65,6 +65,7 @@ class PretrainConfig:
     export_path: str = ""             # write encoder_q (.safetensors/.npz) at end
     steps_per_epoch: int | None = None  # derived from dataset unless set
     knn_monitor: bool = False         # periodic kNN top-1 during pretrain
+    knn_bank_size: int = 4096         # monitor bank cap (train-subset size)
     num_classes: int = 1000           # dataset classes (kNN/eval only)
 
     def replace(self, **kw) -> "PretrainConfig":

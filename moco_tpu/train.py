@@ -55,14 +55,14 @@ def make_feature_fn(model, variant: str):
     return feature_fn
 
 
-def knn_monitor(config, feature_fn, state, dataset, mesh=None, max_bank: int = 4096) -> float:
+def knn_monitor(config, feature_fn, state, dataset, mesh=None) -> float:
     """Periodic kNN top-1 on held-out-ish data (SURVEY §2.5 protocol at
     monitoring scale: embed a train subset as the bank, score a val subset).
     `feature_fn` comes from `make_feature_fn` ONCE per run (recompiling the
     eval forward every epoch costs minutes on the sandbox)."""
     from moco_tpu.evals.knn import encode_dataset
 
-    n = min(len(dataset), max_bank)
+    n = min(len(dataset), config.knn_bank_size)
     split = int(n * 0.8)
     rng = np.random.RandomState(config.seed)
     idx = rng.permutation(len(dataset))[:n]
