@@ -1,0 +1,60 @@
+"""Meters + logging utilities (the reference's AverageMeter/ProgressMeter
+semantics, `main_moco.py:≈L330-375`)."""
+
+import time
+
+from moco_tpu.utils.logging import ProfilerWindow, ScalarWriter
+from moco_tpu.utils.meters import AverageMeter, ProgressMeter, Throughput
+
+
+def test_average_meter_running_average():
+    m = AverageMeter("Loss", ":.2f")
+    m.update(2.0, n=2)
+    m.update(4.0, n=2)
+    assert m.val == 4.0
+    assert m.avg == 3.0
+    assert str(m) == "Loss 4.00 (3.00)"
+    m.reset()
+    assert m.avg == 0.0
+
+
+def test_progress_meter_format(capsys):
+    m = AverageMeter("Loss", ":.1f")
+    m.update(1.5)
+    p = ProgressMeter(100, [m], prefix="Epoch: [3]")
+    p.display(7)
+    out = capsys.readouterr().out
+    assert "Epoch: [3][  7/100]" in out
+    assert "Loss 1.5 (1.5)" in out
+
+
+def test_throughput_per_chip():
+    t = Throughput(num_chips=8)
+    t._t0 = time.perf_counter() - 2.0  # pretend 2 s elapsed
+    t.update(1000)
+    assert 400 < t.imgs_per_sec < 600
+    assert abs(t.imgs_per_sec_per_chip - t.imgs_per_sec / 8) < 1e-9
+
+
+def test_scalar_writer_noop_without_dir(tmp_path):
+    w = ScalarWriter("")
+    w.write(0, {"loss": 1.0})  # must not raise
+    w.close()
+
+
+def test_scalar_writer_skips_unconvertible(tmp_path):
+    try:
+        import tensorboardX  # noqa: F401
+    except ImportError:
+        return
+    w = ScalarWriter(str(tmp_path / "tb"))
+    w.write(1, {"ok": 2.0, "bad": object()})  # bad value skipped, no raise
+    w.close()
+
+
+def test_profiler_window_inactive_without_dir():
+    p = ProfilerWindow("", start=5, stop=10)
+    for step in range(20):
+        p.maybe_toggle(step)  # must never start a trace
+    assert p._active is False
+    p.close()
