@@ -33,7 +33,8 @@ def test_throughput_per_chip():
     t._t0 = time.perf_counter() - 2.0  # pretend 2 s elapsed
     t.update(1000)
     assert 400 < t.imgs_per_sec < 600
-    assert abs(t.imgs_per_sec_per_chip - t.imgs_per_sec / 8) < 1e-9
+    # the two properties sample the clock independently — compare loosely
+    assert abs(t.imgs_per_sec_per_chip - t.imgs_per_sec / 8) < 1.0
 
 
 def test_scalar_writer_noop_without_dir(tmp_path):
