@@ -62,23 +62,32 @@ class Prefetcher:
         self.num_batches = len(indices) // batch_per_host
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        self._err: BaseException | None = None
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
     def _worker(self):
-        for b in range(self.num_batches):
-            item = self.dataset.get_batch(
-                self.indices[b * self.batch : (b + 1) * self.batch]
-            )
-            while not self._stop.is_set():
-                try:
-                    self._q.put(item, timeout=0.1)
-                    break
-                except queue.Full:
-                    continue
-            if self._stop.is_set():
-                return
-        self._q.put(None)
+        # any dataset error (corrupt file, missing path) must reach the
+        # consumer — a silently-dead thread would hang training on q.get()
+        try:
+            for b in range(self.num_batches):
+                item = self.dataset.get_batch(
+                    self.indices[b * self.batch : (b + 1) * self.batch]
+                )
+                if not self._put(item):
+                    return
+        except Exception as e:
+            self._err = e
+        self._put(None)
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def close(self):
         """Unblock and join the staging thread (consumers that break out of
@@ -103,6 +112,8 @@ class Prefetcher:
         while True:
             item = self._q.get()
             if item is None:
+                if self._err is not None:
+                    raise self._err
                 return
             imgs, labels = item
             yield (
@@ -114,9 +125,18 @@ class Prefetcher:
         return self.num_batches
 
 
-def epoch_loader(dataset, epoch: int, seed: int, global_batch: int, mesh: Mesh) -> Prefetcher:
-    """One epoch of sharded batches (sampler.set_epoch + DataLoader in one)."""
+def epoch_loader(
+    dataset, epoch: int, seed: int, global_batch: int, mesh: Mesh,
+    skip_batches: int = 0,
+) -> Prefetcher:
+    """One epoch of sharded batches (sampler.set_epoch + DataLoader in one).
+
+    `skip_batches` drops the first N global batches at the index level (no
+    decode, no H2D) — used by mid-epoch resume to fast-forward to the first
+    unconsumed batch of the interrupted epoch."""
     perm = epoch_permutation(len(dataset), epoch, seed, global_batch)
     local = host_shard(perm, global_batch)
     per_host = global_batch // jax.process_count()
+    if skip_batches:
+        local = local[skip_batches * per_host:]
     return Prefetcher(dataset, local, per_host, mesh)

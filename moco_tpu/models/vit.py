@@ -1,7 +1,8 @@
 """Vision Transformer backbone for MoCo v3 (BASELINE config 5; SURVEY §2.9).
 
 Rebuild of the sibling repo's `vits.py` (`moco-v3`): ViT-S/16 = 12 blocks,
-width 384, 6 heads; 224² → 14×14 = 196 patch tokens + a class token.
+width 384, **12 heads** (head dim 32 — moco-v3's `vit_small` deliberately
+doubles timm's 6 heads); 224² → 14×14 = 196 patch tokens + a class token.
 MoCo-v3 specifics reproduced here:
 
 - FIXED 2-D sin-cos positional embedding (not learned) — the paper's choice
@@ -78,7 +79,7 @@ class ViT(nn.Module):
     patch_size: int = 16
     width: int = 384
     depth: int = 12
-    num_heads: int = 6
+    num_heads: int = 12
     mlp_ratio: float = 4.0
     num_classes: int | None = None
     frozen_patch_embed: bool = True
@@ -121,7 +122,10 @@ class ViT(nn.Module):
         return nn.Dense(self.num_classes, param_dtype=jnp.float32, name="head")(feat)
 
 
-ViT_Small = partial(ViT, width=384, depth=12, num_heads=6)
+# moco-v3's vits.py defines vit_small with 12 heads (head dim 32), NOT
+# timm's 6 — matching it exactly so the preset reproduces the reference
+# attention architecture (ADVICE r1).
+ViT_Small = partial(ViT, width=384, depth=12, num_heads=12)
 ViT_Base = partial(ViT, width=768, depth=12, num_heads=12)
 
 VIT_ARCHS = {"vit_small": ViT_Small, "vit_base": ViT_Base}

@@ -141,6 +141,12 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
     # device→host sync (~70 ms on the relay) serializing every iteration
     global_step = int(state.step)
     start_epoch = global_step // steps_per_epoch
+    # a checkpoint saved after a mid-epoch max_steps break has step not
+    # divisible by steps_per_epoch; skip the resumed epoch's already-consumed
+    # batches so no data is replayed and epoch boundaries stay aligned with
+    # state.step (the epoch_loader permutation is deterministic per epoch, so
+    # batch i here is bit-identical to batch i of the interrupted run)
+    resume_skip = global_step % steps_per_epoch
     total_steps = max_steps or config.epochs * steps_per_epoch
     last_metrics: dict = {}
     feature_fn = make_feature_fn(model, config.variant) if config.knn_monitor else None
@@ -168,10 +174,14 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
                 prefix=f"Epoch: [{epoch}]",
             )
             throughput = Throughput(n_chips)
-            loader = epoch_loader(dataset, epoch, config.seed, config.batch_size, mesh)
+            skip = resume_skip if epoch == start_epoch else 0
+            loader = epoch_loader(
+                dataset, epoch, config.seed, config.batch_size, mesh,
+                skip_batches=skip,
+            )
             end = time.perf_counter()
             try:
-                for i, (imgs, _labels) in enumerate(loader):
+                for i, (imgs, _labels) in enumerate(loader, start=skip):
                     if i >= steps_per_epoch:  # steps_per_epoch may cap the epoch
                         break
                     data_time.update(time.perf_counter() - end)

@@ -111,6 +111,59 @@ def test_epoch_loader_yields_sharded_batches(mesh8):
     assert len(imgs.sharding.device_set) == 8
 
 
+def test_prefetcher_propagates_dataset_error(mesh8):
+    """A dataset error (corrupt/missing file) must raise in the consumer,
+    not kill the staging thread and hang the q.get()."""
+
+    class BadDataset:
+        num_classes = 2
+
+        def __len__(self):
+            return 64
+
+        def get_batch(self, indices):
+            raise ValueError("corrupt file: synthetic test failure")
+
+    loader = epoch_loader(BadDataset(), epoch=0, seed=0, global_batch=16, mesh=mesh8)
+    try:
+        with pytest.raises(ValueError, match="corrupt file"):
+            list(loader)
+    finally:
+        loader.close()
+
+
+def test_prefetcher_error_after_good_batches(mesh8):
+    """Errors mid-epoch surface after the already-staged batches drain."""
+
+    class FlakyDataset:
+        num_classes = 2
+
+        def __init__(self):
+            self.calls = 0
+
+        def __len__(self):
+            return 64
+
+        def get_batch(self, indices):
+            self.calls += 1
+            if self.calls > 2:
+                raise OSError("decode failed")
+            return (
+                np.zeros((len(indices), 8, 8, 3), np.uint8),
+                np.zeros((len(indices),), np.int32),
+            )
+
+    loader = epoch_loader(FlakyDataset(), epoch=0, seed=0, global_batch=16, mesh=mesh8)
+    try:
+        seen = 0
+        with pytest.raises(OSError, match="decode failed"):
+            for _batch in loader:
+                seen += 1
+        assert seen == 2
+    finally:
+        loader.close()
+
+
 def test_solarize_semantics():
     from moco_tpu.data.augment import AugConfig, _random_solarize
     import jax as _jax

@@ -42,6 +42,45 @@ def test_v3_through_driver(mesh8, tmp_path):
 
 
 @pytest.mark.slow
+def test_midepoch_resume_no_replay(mesh8, tmp_path):
+    """A checkpoint saved after a mid-epoch max_steps break must resume at
+    the NEXT batch of that epoch, not replay the epoch from its start
+    (ADVICE r1): an interrupted run continued to step 6 must be bit-identical
+    to an uninterrupted 6-step run."""
+    import jax
+
+    base = dict(
+        arch="resnet_tiny",
+        dataset="synthetic",
+        image_size=16,
+        batch_size=32,
+        num_negatives=64,
+        embed_dim=16,
+        epochs=2,
+        steps_per_epoch=4,
+        compute_dtype="float32",
+        knn_monitor=False,
+        print_freq=100,
+    )
+    uninterrupted = get_preset("cifar10-moco-v1").replace(**base, ckpt_dir="")
+    state_a, _ = train(uninterrupted, mesh8, max_steps=6)
+
+    interrupted = get_preset("cifar10-moco-v1").replace(
+        **base, ckpt_dir=str(tmp_path / "ckpt")
+    )
+    state_mid, _ = train(interrupted, mesh8, max_steps=2)  # breaks mid-epoch 0
+    assert int(state_mid.step) == 2
+    state_b, _ = train(interrupted.replace(resume="auto"), mesh8, max_steps=6)
+
+    assert int(state_a.step) == int(state_b.step) == 6
+    for pa, pb in zip(
+        jax.tree.leaves(state_a.params_q), jax.tree.leaves(state_b.params_q)
+    ):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+    np.testing.assert_array_equal(np.asarray(state_a.queue), np.asarray(state_b.queue))
+
+
+@pytest.mark.slow
 def test_imagefolder_through_driver(mesh8, tmp_path):
     """Real-data path: JPEG tree → (native or PIL) staging → device aug →
     step. Images are written per class from distinct base colors so the
