@@ -1,13 +1,21 @@
 """North-star benchmark: MoCo-v2 ResNet-50 pretrain throughput (imgs/sec/chip).
 
-Runs the REAL training step — on-device two-crop augmentation + both encoder
-forwards + ShuffleBN collectives + InfoNCE + backward + SGD + donated queue
-update — on whatever chips are present (the sandbox exposes one), with the
-full 65536-slot queue and bf16 compute, and compares per-chip throughput
-against the reference's 8xV100 number (BASELINE.md: ~1340 imgs/s global =
-168 imgs/s/GPU, derived from the README's ~53 h / 200 epochs).
+Default mode runs the REAL training step — on-device two-crop augmentation +
+both encoder forwards + ShuffleBN collectives + InfoNCE + backward + SGD +
+donated queue update — on whatever chips are present (the sandbox exposes
+one), with the full 65536-slot queue and bf16 compute, and compares per-chip
+throughput against the reference's 8xV100 number (BASELINE.md: ~1340 imgs/s
+global = 168 imgs/s/GPU, derived from the README's ~53 h / 200 epochs).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Extra modes (VERDICT r1: the input path must be measured, not amortized away):
+  --mode input   host JPEG→staging throughput (native C++ loader) across
+                 thread counts, plus the PIL fallback — one JSON line with
+                 the best imgs/sec and per-thread detail.
+  --mode e2e     the timed train loop fed by epoch_loader + ImageFolder over
+                 a generated JPEG tree (honest host-decode-in-the-loop
+                 number) — one JSON line, imgs/sec/chip.
 """
 
 from __future__ import annotations
@@ -20,6 +28,164 @@ import jax.numpy as jnp
 import numpy as np
 
 BASELINE_IMGS_PER_SEC_PER_CHIP = 168.0  # 8xV100 MoCo-v2, BASELINE.md
+
+
+def _make_jpeg_tree(root, n_images: int = 256, classes: int = 4, size=(500, 375)):
+    """ImageNet-shaped synthetic JPEGs (4:3, quality 85, ~30-60 KB)."""
+    import os
+
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    paths = []
+    for c in range(classes):
+        d = os.path.join(root, f"class{c}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(n_images // classes):
+            # low-frequency content + noise: realistic JPEG entropy, cheap
+            base = rng.randint(0, 256, (6, 8, 3)).astype(np.uint8)
+            img = np.asarray(
+                Image.fromarray(base).resize(size, Image.BILINEAR), np.uint8
+            )
+            img = np.clip(
+                img.astype(np.int16) + rng.randint(-25, 25, img.shape[:2] + (1,)),
+                0, 255,
+            ).astype(np.uint8)
+            p = os.path.join(d, f"{i}.jpg")
+            Image.fromarray(img).save(p, quality=85)
+            paths.append(p)
+    return paths
+
+
+def bench_input():
+    """Host staging throughput: native loader by thread count + PIL."""
+    import os
+    import tempfile
+
+    from moco_tpu.data.datasets import ImageFolder
+    from moco_tpu.data.native_loader import NativeStagingLoader
+
+    root = tempfile.mkdtemp(prefix="bench_jpeg_")
+    paths = _make_jpeg_tree(root)
+    ncpu = os.cpu_count() or 1
+    detail = {}
+    best = 0.0
+    try:
+        for threads in sorted({1, 2, 4, max(1, ncpu)}):
+            loader = NativeStagingLoader(256, 512, threads)
+            loader.load_batch(paths[:32])  # warm the pool
+            t0 = time.perf_counter()
+            _, _, failures = loader.load_batch(paths)
+            dt = time.perf_counter() - t0
+            assert failures == 0
+            rate = len(paths) / dt
+            detail[f"native_{threads}t"] = round(rate, 1)
+            best = max(best, rate)
+    except RuntimeError as e:
+        # no native toolchain on this host: report the PIL path alone,
+        # mirroring ImageFolder's backend="auto" degradation
+        detail["native_unavailable"] = str(e)
+    folder = ImageFolder(root, stage_size=256, backend="pil", num_workers=1)
+    sub = np.arange(min(64, len(folder)))
+    folder.get_batch(sub[:8])
+    t0 = time.perf_counter()
+    folder.get_batch(sub)
+    detail["pil_1w"] = round(len(sub) / (time.perf_counter() - t0), 1)
+    best = max(best, detail["pil_1w"])
+    # the input-path question (SURVEY §7 hard-part 4): one 8-chip host must
+    # stage ~8*step_rate imgs/s; report how many of THESE cores that takes
+    per_core = detail.get("native_1t", detail["pil_1w"])
+    print(
+        json.dumps(
+            {
+                "metric": "host_staging_throughput",
+                "value": round(best, 1),
+                "unit": "imgs/sec",
+                "vs_baseline": round(best / (8 * BASELINE_IMGS_PER_SEC_PER_CHIP), 3),
+                "detail": detail,
+                "cores_on_this_host": ncpu,
+                "cores_per_8x1650imgs_chip_host": round(8 * 1650 / per_core, 1),
+            }
+        )
+    )
+
+
+def bench_e2e():
+    """Input-fed training: epoch_loader + ImageFolder (JPEG decode in the
+    loop) feeding the real MoCo-v2 step. The gap to the default (staged)
+    metric is exactly the un-overlapped host input cost on this host."""
+    import tempfile
+
+    from moco_tpu.config import get_preset
+    from moco_tpu.data.augment import build_two_crops_sharded, v2_aug_config
+    from moco_tpu.data.datasets import ImageFolder
+    from moco_tpu.data.loader import epoch_loader
+    from moco_tpu.parallel.mesh import create_mesh
+    from moco_tpu.train_state import create_train_state
+    from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+    mesh = create_mesh(n_chips)
+    root = tempfile.mkdtemp(prefix="bench_e2e_")
+    batch = (128 if on_tpu else 8) * n_chips
+    _make_jpeg_tree(root, n_images=batch * 4)
+    if on_tpu:
+        config = get_preset("imagenet-moco-v2").replace(batch_size=batch)
+        steps = 6
+    else:
+        config = get_preset("imagenet-moco-v2").replace(
+            arch="resnet_tiny", cifar_stem=True, compute_dtype="float32",
+            image_size=32, batch_size=batch, num_negatives=64 * n_chips,
+            embed_dim=32,
+        )
+        steps = 3
+    dataset = ImageFolder(root, stage_size=256)
+    model = build_encoder(config)
+    tx, sched = build_optimizer(config, steps_per_epoch=1000)
+    state = create_train_state(
+        jax.random.key(0), model, tx,
+        (batch // n_chips, config.image_size, config.image_size, 3),
+        config.num_negatives, config.embed_dim,
+    )
+    step_fn = build_train_step(config, model, tx, mesh, 1000, sched)
+    two_crops = build_two_crops_sharded(v2_aug_config(config.image_size), mesh)
+    data_key = jax.random.key(1)
+
+    def run_epoch(epoch, max_steps):
+        nonlocal state
+        n = 0
+        loader = epoch_loader(dataset, epoch, 0, batch, mesh)
+        try:
+            for imgs, _labels, extents in loader:
+                im_q, im_k = two_crops(imgs, jax.random.fold_in(data_key, n), extents)
+                state, metrics = step_fn(state, im_q, im_k)
+                n += 1
+                if n >= max_steps:
+                    break
+        finally:
+            loader.close()
+        float(metrics["loss"])  # d2h sync (block_until_ready lies on the relay)
+        return n
+
+    run_epoch(0, 2)  # compile + relay warmup
+    t0 = time.perf_counter()
+    n = run_epoch(1, steps)
+    dt = time.perf_counter() - t0
+    per_chip = batch * n / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "moco_v2_r50_e2e_input_fed_throughput_per_chip"
+                if on_tpu
+                else "moco_v2_tiny_cpu_e2e_proxy_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
 
 
 def main():
@@ -111,4 +277,14 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mode", choices=["step", "input", "e2e"], default="step")
+    args = parser.parse_args()
+    if args.mode == "input":
+        bench_input()
+    elif args.mode == "e2e":
+        bench_e2e()
+    else:
+        main()

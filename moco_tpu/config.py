@@ -40,6 +40,8 @@ class PretrainConfig:
     data_dir: str = ""
     image_size: int = 224
     aug_plus: bool = False            # --aug-plus (v2 aug stack)
+    crop_min: float = 0.0             # v3 --crop-min (0 = variant default:
+                                      # 0.08 for ViT, the R50 recipe uses 0.2)
     num_workers: int = 4              # host-side loader threads (-j)
     # optimization (reference: SGD momentum .9, wd 1e-4, lr .03, batch 256)
     optimizer: str = "sgd"            # sgd | adamw | lars
@@ -166,6 +168,29 @@ PRESETS: dict[str, PretrainConfig | EvalConfig] = {
         warmup_epochs=40,
         cos=True,
         aug_plus=True,
+        dataset="imagefolder",
+        compute_dtype="bfloat16",
+    ),
+    # 5b. MoCo-v3 ResNet-50 leg (sibling repo's `MoCo_ResNet`; SURVEY §2.9
+    #     "ResNet recipe uses LARS"): LARS, lr 0.3·batch/256, wd 1.5e-6,
+    #     100 ep / 10 warmup, T=1.0 (moco-v3 default), crop-min 0.2,
+    #     m=0.99 cosine-ramped — the repo's R50 README command.
+    "imagenet-moco-v3-r50": PretrainConfig(
+        name="imagenet-moco-v3-r50",
+        variant="v3",
+        arch="resnet50",
+        embed_dim=256,
+        momentum_ema=0.99,
+        momentum_ramp=True,
+        temperature=1.0,
+        optimizer="lars",
+        lr=0.3 * 4096 / 256,
+        weight_decay=1.5e-6,
+        batch_size=4096,
+        epochs=100,
+        warmup_epochs=10,
+        cos=True,
+        crop_min=0.2,
         dataset="imagefolder",
         compute_dtype="bfloat16",
     ),

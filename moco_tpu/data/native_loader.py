@@ -4,8 +4,10 @@ The reference leans on native code for its input path — PIL/libjpeg decode in
 32 worker processes (`main_moco.py:≈L260-270`), or NVIDIA DALI in the bl0
 fork (SURVEY §2.10). This is the TPU-native equivalent: a C++ thread pool in
 the single controller process that turns JPEG files into fixed-size uint8
-staging tiles (decode → shorter-side bilinear resize → center crop); the
-randomized augmentation then runs ON DEVICE (data/augment.py).
+staging canvases (decode → transpose-if-portrait → bilinear fit-resize of
+the WHOLE image + edge-replicated padding, with a per-image
+`(valid_h, valid_w, rot)` extent); the randomized augmentation then runs ON
+DEVICE (data/augment.py) over the true image area.
 
 The shared library is compiled on first use (g++ + libjpeg, both in the
 image); if the toolchain is unavailable, `ImageFolder` silently falls back
@@ -44,45 +46,49 @@ def _ensure_built() -> str | None:
 
 
 class NativeStagingLoader:
-    """Threaded JPEG→staging-tile batch loader. Raises RuntimeError if the
+    """Threaded JPEG→staging-canvas batch loader. Raises RuntimeError if the
     native library cannot be built (callers fall back to PIL)."""
 
-    def __init__(self, stage_size: int, num_threads: int | None = None):
+    def __init__(self, stage_h: int, stage_w: int, num_threads: int | None = None):
         path = _ensure_built()
         if path is None:
             raise RuntimeError("native staging loader unavailable (build failed)")
         self._lib = ctypes.CDLL(path)
         self._lib.sl_create.restype = ctypes.c_void_p
-        self._lib.sl_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        self._lib.sl_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
         self._lib.sl_load_batch.restype = ctypes.c_int
         self._lib.sl_load_batch.argtypes = [
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_char_p),
             ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
         ]
         self._lib.sl_destroy.argtypes = [ctypes.c_void_p]
         if num_threads is None:
             num_threads = max(os.cpu_count() or 1, 1)
-        self.stage_size = stage_size
-        self._handle = self._lib.sl_create(num_threads, stage_size)
+        self.stage_h = stage_h
+        self.stage_w = stage_w
+        self._handle = self._lib.sl_create(num_threads, stage_h, stage_w)
         if not self._handle:
             raise RuntimeError("sl_create failed")
 
-    def load_batch(self, paths: list[str]) -> tuple[np.ndarray, int]:
-        """Decode `paths` in parallel → (`[n, S, S, 3] uint8`, n_failures).
-        Failed images come back as zero tiles."""
+    def load_batch(self, paths: list[str]) -> tuple[np.ndarray, np.ndarray, int]:
+        """Decode `paths` in parallel →
+        (`[n, H, W, 3] uint8`, `[n, 3] int32 (h, w, rot)`, n_failures).
+        Failed images come back as zero canvases with full-canvas extent."""
         n = len(paths)
-        s = self.stage_size
-        out = np.empty((n, s, s, 3), dtype=np.uint8)
+        out = np.empty((n, self.stage_h, self.stage_w, 3), dtype=np.uint8)
+        extents = np.empty((n, 3), dtype=np.int32)
         arr = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
         failures = self._lib.sl_load_batch(
             self._handle,
             arr,
             n,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            extents.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         )
-        return out, int(failures)
+        return out, extents, int(failures)
 
     def __del__(self):
         handle = getattr(self, "_handle", None)

@@ -38,7 +38,11 @@ def encode_dataset(
     is padded so the forward compiles once. Pass a precompiled `feature_fn`
     (signature `(params, stats, images)`) to reuse a jit cache across calls —
     the during-training kNN monitor does."""
-    cfg = eval_aug_config(config.image_size)
+    from moco_tpu.data.augment import default_eval_crop_frac
+
+    cfg = eval_aug_config(
+        config.image_size, crop_frac=default_eval_crop_frac(config.image_size)
+    )
     key = jax.random.key(0)
 
     if feature_fn is None:
@@ -64,14 +68,15 @@ def encode_dataset(
     if indices is None:
         indices = np.arange(len(dataset))
     feats, labels = [], []
+    from moco_tpu.data.loader import stage_eval_batch
+
     for start in range(0, len(indices), batch):
         idx = indices[start : start + batch]
-        imgs, lbls = dataset.get_batch(idx)
+        imgs, lbls, extents = stage_eval_batch(
+            dataset.get_batch(idx), batch, sharding
+        )
         valid = len(idx)
-        if valid < batch:
-            imgs = np.concatenate([imgs, np.repeat(imgs[-1:], batch - valid, 0)])
-        imgs = jnp.asarray(imgs) if sharding is None else jax.device_put(imgs, sharding)
-        images = augment_batch(imgs, key, cfg)
+        images = augment_batch(imgs, key, cfg, extents)
         feats.append(np.asarray(feature_fn(params, stats, images))[:valid])
         labels.append(lbls)
     return np.concatenate(feats), np.concatenate(labels)

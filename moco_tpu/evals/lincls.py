@@ -138,7 +138,11 @@ def build_lincls_steps(config: EvalConfig, model, tx, mesh):
 
 def validate(eval_step, fc, params, stats, dataset, config: EvalConfig, mesh) -> tuple[float, float]:
     """Center-crop validation (`main_lincls.py:≈L342-380`)."""
-    cfg = eval_aug_config(config.image_size)
+    from moco_tpu.data.augment import default_eval_crop_frac
+
+    cfg = eval_aug_config(
+        config.image_size, crop_frac=default_eval_crop_frac(config.image_size)
+    )
     key = jax.random.key(0)
     n = len(dataset)
     b = config.batch_size
@@ -147,17 +151,17 @@ def validate(eval_step, fc, params, stats, dataset, config: EvalConfig, mesh) ->
     # config.batch_size is mesh-divisible (train_lincls checks local_batch_size)
     sharding = batch_sharded(mesh) if mesh is not None and mesh.size > 1 else None
     c1 = c5 = seen = 0.0
+    from moco_tpu.data.loader import stage_eval_batch
+
     for start in range(0, n, b):
         idx = np.arange(start, min(start + b, n))
-        imgs, labels = dataset.get_batch(idx)
+        # pad the label tail with -1 (never matches a prediction) so every
+        # image is scored and shapes stay fixed
+        imgs, labels, extents = stage_eval_batch(
+            dataset.get_batch(idx), b, sharding, pad_label=-1
+        )
         valid = len(idx)
-        if valid < b:
-            # pad the tail (labels with -1, which can never match a
-            # prediction) so every image is scored and shapes stay fixed
-            imgs = np.concatenate([imgs, np.repeat(imgs[-1:], b - valid, 0)])
-            labels = np.concatenate([labels, np.full(b - valid, -1, labels.dtype)])
-        imgs = jnp.asarray(imgs) if sharding is None else jax.device_put(imgs, sharding)
-        images = augment_batch(imgs, key, cfg)
+        images = augment_batch(imgs, key, cfg, extents)
         m = eval_step(fc, params, stats, images, jnp.asarray(labels))
         c1 += float(m["correct1"])
         c5 += float(m["correct5"])
@@ -264,8 +268,10 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
         progress = ProgressMeter(steps_per_epoch, [losses, top1], f"Epoch: [{epoch}]")
         loader = epoch_loader(train_set, epoch, config.seed, config.batch_size, mesh)
         try:
-            for i, (imgs, labels) in enumerate(loader):
-                images = augment_batch(imgs, jax.random.fold_in(key, step), aug)
+            for i, (imgs, labels, extents) in enumerate(loader):
+                images = augment_batch(
+                    imgs, jax.random.fold_in(key, step), aug, extents
+                )
                 fc, opt_state, metrics = train_step(
                     fc, opt_state, backbone_params, backbone_stats, images, labels
                 )

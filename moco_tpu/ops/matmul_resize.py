@@ -24,11 +24,17 @@ import jax.numpy as jnp
 
 
 def interp_matrix(
-    src_size: int, out_size: int, crop_start, crop_size, antialias: bool = True
+    src_size: int, out_size: int, crop_start, crop_size, antialias: bool = True,
+    valid_size=None,
 ) -> jax.Array:
     """[out_size, src_size] row-stochastic interpolation weights mapping the
     window [crop_start, crop_start + crop_size) onto out_size samples.
-    `crop_start`/`crop_size` may be traced scalars (static shapes)."""
+    `crop_start`/`crop_size` may be traced scalars (static shapes).
+
+    `valid_size` (optional, traced): image content occupies rows
+    `[0, valid_size)` of the source (rectangle staging, datasets.py) — taps
+    beyond it are masked out and the row renormalized, which reproduces
+    exactly the boundary handling a tightly-sized image would get."""
     scale = crop_size / out_size
     o = jnp.arange(out_size, dtype=jnp.float32)
     pos = crop_start + (o + 0.5) * scale - 0.5          # source-space centers
@@ -36,6 +42,8 @@ def interp_matrix(
     support = jnp.maximum(scale, 1.0) if antialias else jnp.float32(1.0)
     dist = jnp.abs(pos[:, None] - idx[None, :]) / support
     w = jnp.clip(1.0 - dist, 0.0, None)
+    if valid_size is not None:
+        w = w * (idx[None, :] < valid_size)
     return w / jnp.maximum(jnp.sum(w, axis=1, keepdims=True), 1e-8)
 
 
@@ -47,10 +55,12 @@ def crop_resize(
     crop_w,
     out_size: int,
     antialias: bool = True,
+    valid_h=None,
+    valid_w=None,
 ) -> jax.Array:
     """Resample the box [y0:y0+crop_h, x0:x0+crop_w] to [out, out, C]."""
-    rv = interp_matrix(img.shape[0], out_size, y0, crop_h, antialias)
-    rh = interp_matrix(img.shape[1], out_size, x0, crop_w, antialias)
+    rv = interp_matrix(img.shape[0], out_size, y0, crop_h, antialias, valid_h)
+    rh = interp_matrix(img.shape[1], out_size, x0, crop_w, antialias, valid_w)
     # [O,H]x[H,W,C] then [O,W,C]x[W,O'] — two dense contractions on the MXU
     tmp = jnp.einsum("oh,hwc->owc", rv, img, preferred_element_type=jnp.float32)
     return jnp.einsum("pw,owc->opc", rh, tmp, preferred_element_type=jnp.float32)

@@ -129,7 +129,10 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
         state = jax.device_put(state, replicated(mesh))
 
     if config.variant == "v3":
-        aug_cfg = v3_aug_configs(config.image_size)  # asymmetric view pair
+        # asymmetric view pair; crop_min is the repo's --crop-min knob
+        aug_cfg = v3_aug_configs(
+            config.image_size, min_scale=config.crop_min or 0.08
+        )
     elif config.aug_plus:
         aug_cfg = v2_aug_config(config.image_size)
     else:
@@ -181,12 +184,12 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
             )
             end = time.perf_counter()
             try:
-                for i, (imgs, _labels) in enumerate(loader, start=skip):
+                for i, (imgs, _labels, extents) in enumerate(loader, start=skip):
                     if i >= steps_per_epoch:  # steps_per_epoch may cap the epoch
                         break
                     data_time.update(time.perf_counter() - end)
                     step_key = jax.random.fold_in(data_key, global_step)
-                    im_q, im_k = two_crops_fn(imgs, step_key)
+                    im_q, im_k = two_crops_fn(imgs, step_key, extents)
                     profiler.maybe_toggle(global_step)
                     state, metrics = step_fn(state, im_q, im_k)
                     global_step += 1

@@ -124,8 +124,18 @@ def build_optimizer(
     elif config.optimizer == "adamw":
         tx = optax.adamw(sched, weight_decay=config.weight_decay)
     elif config.optimizer == "lars":
+        # moco-v3's LARS (R50 recipe) excludes bias/BN (1-D) params from BOTH
+        # weight decay and the trust-ratio adaptation — they get plain
+        # momentum SGD at the base lr
+        def dim_mask(params):
+            return jax.tree.map(lambda p: jnp.ndim(p) > 1, params)
+
         tx = optax.lars(
-            sched, weight_decay=config.weight_decay, momentum=config.sgd_momentum
+            sched,
+            weight_decay=config.weight_decay,
+            weight_decay_mask=dim_mask,
+            trust_ratio_mask=dim_mask,
+            momentum=config.sgd_momentum,
         )
     else:
         raise ValueError(f"unknown optimizer {config.optimizer!r}")

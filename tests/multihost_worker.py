@@ -61,7 +61,7 @@ def main():
     loader = epoch_loader(dataset, epoch=0, seed=0, global_batch=GLOBAL_B, mesh=mesh)
     steps = 0
     try:
-        for imgs, _labels in loader:
+        for imgs, _labels, _extents in loader:
             imgs_f32 = imgs.astype(jnp.float32)
             state, metrics = step_fn(state, imgs_f32, imgs_f32)
             steps += 1

@@ -26,7 +26,8 @@ def cifar_dir(tmp_path_factory):
 def test_train_split_concatenates_batches(cifar_dir):
     ds = CIFAR10(cifar_dir, train=True)
     assert len(ds) == 100
-    imgs, labels = ds.get_batch(np.arange(8))
+    imgs, labels, extents = ds.get_batch(np.arange(8))
+    np.testing.assert_array_equal(extents, np.tile([32, 32, 0], (8, 1)))
     assert imgs.shape == (8, 32, 32, 3) and imgs.dtype == np.uint8
     assert labels.shape == (8,)
     assert ds.num_classes == 10

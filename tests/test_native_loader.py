@@ -34,7 +34,7 @@ def jpeg_tree(tmp_path_factory):
 @pytest.fixture(scope="module")
 def native(jpeg_tree):
     try:
-        return NativeStagingLoader(stage_size=32, num_threads=2)
+        return NativeStagingLoader(stage_h=32, stage_w=64, num_threads=2)
     except RuntimeError as e:
         pytest.skip(f"native loader unavailable: {e}")
 
@@ -42,27 +42,54 @@ def native(jpeg_tree):
 def test_native_decode_matches_pil(jpeg_tree, native):
     folder = ImageFolder(jpeg_tree, stage_size=32, backend="pil")
     paths = [e.path for e in folder.entries]
-    out, failures = native.load_batch(paths)
+    out, extents, failures = native.load_batch(paths)
     assert failures == 0
-    assert out.shape == (len(paths), 32, 32, 3)
-    pil_imgs, _ = folder.get_batch(np.arange(len(paths)))
+    assert out.shape == (len(paths), 32, 64, 3)
+    pil_imgs, _, pil_extents = folder.get_batch(np.arange(len(paths)))
+    # staged geometry must agree EXACTLY (same fit math, same rounding)
+    np.testing.assert_array_equal(extents, pil_extents)
     # different bilinear implementations: require close agreement, not equality
     diff = np.abs(out.astype(np.int32) - pil_imgs.astype(np.int32))
     assert diff.mean() < 12.0, f"native vs PIL mean abs diff {diff.mean():.1f}"
 
 
+def test_native_stages_whole_image_with_extent(jpeg_tree, native):
+    """The canvas holds the WHOLE image top-left (portrait staged transposed)
+    with edge-replicated padding — not a center crop."""
+    folder = ImageFolder(jpeg_tree, stage_size=32, backend="pil")
+    paths = [e.path for e in folder.entries]
+    out, extents, failures = native.load_batch(paths)
+    assert failures == 0
+    from PIL import Image
+
+    for i, p in enumerate(paths):
+        w, h = Image.open(p).size
+        nh, nw, rot = extents[i]
+        assert rot == (1 if h > w else 0)
+        src_h, src_w = (w, h) if rot else (h, w)  # staged orientation
+        assert nh == min(32, max(1, int(src_h * min(32 / src_h, 64 / src_w) + 0.5)))
+        assert 1 <= nw <= 64 and 1 <= nh <= 32
+        # edge replication: padding column equals the last content column
+        if nw < 64:
+            np.testing.assert_array_equal(out[i, :nh, nw], out[i, :nh, nw - 1])
+        if nh < 32:
+            np.testing.assert_array_equal(out[i, nh], out[i, nh - 1])
+
+
 def test_native_handles_corrupt_file(tmp_path, native):
     bad = tmp_path / "bad.jpg"
     bad.write_bytes(b"not a jpeg at all")
-    out, failures = native.load_batch([str(bad)])
+    out, extents, failures = native.load_batch([str(bad)])
     assert failures == 1
     np.testing.assert_array_equal(out[0], 0)
+    np.testing.assert_array_equal(extents[0], [32, 64, 0])
 
 
 def test_imagefolder_uses_native_backend(jpeg_tree):
     folder = ImageFolder(jpeg_tree, stage_size=32, backend="auto")
-    imgs, labels = folder.get_batch(np.arange(4))
-    assert imgs.shape == (4, 32, 32, 3)
+    imgs, labels, extents = folder.get_batch(np.arange(4))
+    assert imgs.shape == (4, 32, 64, 3)
+    assert extents.shape == (4, 3)
     assert folder.num_classes == 2
     if folder._native is None:
         pytest.skip("native backend not built in this environment")
@@ -70,6 +97,7 @@ def test_imagefolder_uses_native_backend(jpeg_tree):
 
 def test_imagefolder_pil_fallback_matches_shapes(jpeg_tree):
     a = ImageFolder(jpeg_tree, stage_size=32, backend="pil")
-    imgs, labels = a.get_batch(np.arange(6))
-    assert imgs.shape == (6, 32, 32, 3)
+    imgs, labels, extents = a.get_batch(np.arange(6))
+    assert imgs.shape == (6, 32, 64, 3)
+    assert extents.shape == (6, 3)
     assert sorted(set(labels.tolist())) == [0, 1]
