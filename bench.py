@@ -150,8 +150,13 @@ def bench_e2e():
         config.num_negatives, config.embed_dim,
     )
     step_fn = build_train_step(config, model, tx, mesh, 1000, sched)
-    two_crops = build_two_crops_sharded(v2_aug_config(config.image_size), mesh)
-    data_key = jax.random.key(1)
+    from moco_tpu.data.augment import with_dtype
+    from moco_tpu.train_step import build_fused_step
+
+    two_crops = build_two_crops_sharded(
+        with_dtype(v2_aug_config(config.image_size), config.compute_dtype), mesh
+    )
+    fused = build_fused_step(step_fn, two_crops, jax.random.key(1))
 
     def run_epoch(epoch, max_steps):
         nonlocal state
@@ -159,8 +164,7 @@ def bench_e2e():
         loader = epoch_loader(dataset, epoch, 0, batch, mesh)
         try:
             for imgs, _labels, extents in loader:
-                im_q, im_k = two_crops(imgs, jax.random.fold_in(data_key, n), extents)
-                state, metrics = step_fn(state, im_q, im_k)
+                state, metrics = fused(state, imgs, extents, n)
                 n += 1
                 if n >= max_steps:
                     break
@@ -226,7 +230,13 @@ def main():
     )
     step_fn = build_train_step(config, model, tx, mesh, 1000, sched)
 
-    aug_cfg = v2_aug_config(config.image_size)
+    # aug in the compute dtype (bf16 on TPU) fused into ONE program with the
+    # step via the SAME build_fused_step the train driver uses
+    from moco_tpu.data.augment import with_dtype
+    from moco_tpu.data.datasets import full_extents
+    from moco_tpu.train_step import build_fused_step
+
+    aug_cfg = with_dtype(v2_aug_config(config.image_size), config.compute_dtype)
     two_crops = build_two_crops_sharded(aug_cfg, mesh)
     # one staged uint8 batch; re-augmented on device every step (two_crops),
     # representing the steady-state input path with host decode amortized
@@ -235,11 +245,11 @@ def main():
     imgs_u8 = jnp.asarray(
         rng.randint(0, 256, (config.batch_size, stage, stage, 3), dtype=np.uint8)
     )
-    data_key = jax.random.key(1)
+    extents = full_extents(config.batch_size, stage, stage)
+    fused = build_fused_step(step_fn, two_crops, jax.random.key(1))
 
     def one_step(state, i):
-        im_q, im_k = two_crops(imgs_u8, jax.random.fold_in(data_key, i))
-        return step_fn(state, im_q, im_k)
+        return fused(state, imgs_u8, extents, i)
 
     # Timing notes (measured on the sandbox's tunneled v5e):
     # - `block_until_ready` does NOT reliably synchronize on the experimental

@@ -36,6 +36,7 @@ import numpy as np
 # backend at import time, breaking late force_cpu_devices() platform selection
 IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+IMAGENET_INV_STD = (1.0 / IMAGENET_STD).astype(np.float32)
 
 
 class AugConfig(NamedTuple):
@@ -59,6 +60,10 @@ class AugConfig(NamedTuple):
     crop_frac: float = 0.875      # deterministic eval: center-crop fraction of
                                   # min(h, w) — 224/256 for the ImageNet protocol,
                                   # 1.0 for the community CIFAR protocol
+    dtype: str = "float32"        # image math dtype; "bfloat16" halves the
+                                  # pipeline's HBM traffic on TPU (quantization
+                                  # ~2^-8 ≈ the u8 source precision; per-pixel
+                                  # HSV math stays f32 inside fusions)
 
 
 def v1_aug_config(out_size: int = 224) -> AugConfig:
@@ -107,6 +112,14 @@ def default_eval_crop_frac(image_size: int) -> float:
     """Community protocol split: small-image datasets (CIFAR) evaluate the
     full image; ImageNet-scale uses the 224/256 center crop."""
     return 1.0 if image_size < 96 else 0.875
+
+
+def with_dtype(cfg, dtype: str):
+    """Set the pipeline dtype on a single AugConfig or a v3 view pair.
+    (AugConfig IS a NamedTuple — the isinstance check must come first.)"""
+    if isinstance(cfg, AugConfig):
+        return cfg._replace(dtype=dtype)
+    return tuple(c._replace(dtype=dtype) for c in cfg)
 
 
 # --------------------------------------------------------------------------
@@ -159,25 +172,27 @@ def _hsv_to_rgb(hsv):
 
 def _jitter_ops(factors, hue_shift, use_hue: bool):
     """The four ColorJitter sub-ops as closures over their sampled factors.
-    Each clamps to [0, 1] like torchvision's `_blend` (float path)."""
+    Each clamps to [0, 1] like torchvision's `_blend` (float path). Same
+    dtype discipline as the fast path: blends in the pipeline dtype,
+    contrast mean and the HSV round-trip in f32."""
     fb, fc, fs = factors
 
     def brightness(x):
-        return jnp.clip(x * fb, 0.0, 1.0)
+        return jnp.clip(x * fb.astype(x.dtype), 0.0, 1.0)
 
     def contrast(x):
-        m = jnp.mean(_grayscale(x))
-        return jnp.clip((x - m) * fc + m, 0.0, 1.0)
+        m = jnp.mean(_grayscale(x), dtype=jnp.float32).astype(x.dtype)
+        return jnp.clip((x - m) * fc.astype(x.dtype) + m, 0.0, 1.0)
 
     def saturation(x):
         g = _grayscale(x)[..., None]
-        return jnp.clip((x - g) * fs + g, 0.0, 1.0)
+        return jnp.clip((x - g) * fs.astype(x.dtype) + g, 0.0, 1.0)
 
     if use_hue:
         def hue(x):
-            hsv = _rgb_to_hsv(x)
+            hsv = _rgb_to_hsv(x.astype(jnp.float32))
             hsv = hsv.at[..., 0].set((hsv[..., 0] + hue_shift) % 1.0)
-            return _hsv_to_rgb(hsv)
+            return _hsv_to_rgb(hsv).astype(x.dtype)
     else:
         def hue(x):
             return x
@@ -216,19 +231,24 @@ def _apply_jitter_ops_fast(img, factors, hue_shift, perm, use_hue: bool):
 
     def cheap_apply(x, op, active):
         g = _grayscale(x)
+        # contrast's mean in f32 (bf16 mean over ~50k pixels loses bits),
+        # cast back so the blend stays in the pipeline dtype
+        mean_g = jnp.mean(g, dtype=jnp.float32).astype(x.dtype)
         m = jnp.where(
-            op == 0, 0.0, jnp.where(op == 1, jnp.mean(g), 0.0)
-        ) + jnp.where(op == 2, 1.0, 0.0) * g[..., None]
-        f = jnp.where(active, f_by_op[op], 1.0)
+            op == 0, x.dtype.type(0.0), jnp.where(op == 1, mean_g, x.dtype.type(0.0))
+        ) + jnp.where(op == 2, x.dtype.type(1.0), x.dtype.type(0.0)) * g[..., None]
+        f = jnp.where(active, f_by_op[op], 1.0).astype(x.dtype)
         return jnp.clip(f * x + (1.0 - f) * m, 0.0, 1.0)
 
     out = img
     for j in range(3):
         out = cheap_apply(out, c_ops[j], j < h_rank)
     if use_hue:
-        hsv = _rgb_to_hsv(out)
+        # HSV math in f32 (piecewise selects are precision-sensitive); the
+        # converts fuse — no extra HBM traffic
+        hsv = _rgb_to_hsv(out.astype(jnp.float32))
         hsv = hsv.at[..., 0].set((hsv[..., 0] + hue_shift) % 1.0)
-        out = _hsv_to_rgb(hsv)
+        out = _hsv_to_rgb(hsv).astype(img.dtype)
     for j in range(3):
         out = cheap_apply(out, c_ops[j], j >= h_rank)
     return out
@@ -277,7 +297,7 @@ def _gaussian_blur(img, key, cfg: AugConfig):
     radius = blur_radius(cfg.out_size)
     # sigma + apply-probability sampling shared with the Pallas path (one
     # source of truth; skip == identity kernel, so it is applied unconditionally)
-    kernel = blur_weights(key, radius, cfg.blur_sigma, cfg.blur_prob)
+    kernel = blur_weights(key, radius, cfg.blur_sigma, cfg.blur_prob).astype(img.dtype)
     # Separable blur as weighted shifted-adds over STATIC slices. Two designs
     # were measured and rejected on the v5e: slice-stack + einsum fuses the
     # whole upstream jitter chain into every tap (~20x recompute), and a
@@ -346,7 +366,7 @@ def _rrc_params(key, ext_h, ext_w, cfg: AugConfig):
     return y0, x0, ch, cw
 
 
-def _random_resized_crop(img, key, cfg: AugConfig, extent):
+def _random_resized_crop(img, key, cfg: AugConfig, extent, flip_key=None):
     """torchvision RandomResizedCrop as fixed-shape dense-matmul resampling
     (crop + antialiased bilinear).
 
@@ -356,8 +376,23 @@ def _random_resized_crop(img, key, cfg: AugConfig, extent):
     landscape canvas shape serves both orientations. The crop is sampled in
     staged coordinates and the output transposed back — exactly equivalent
     to sampling the original orientation, since the ratio distribution is
-    symmetric (log-uniform) and the resample filter separable."""
+    symmetric (log-uniform) and the resample filter separable.
+
+    `flip_key` folds the horizontal flip INTO the resample matrix (reversing
+    the output-axis sampling rows) — bit-equivalent to flipping the crop
+    afterwards, minus one full-image reverse+select pass per view. Every
+    later op commutes with the flip: jitter/grayscale/solarize are
+    pixelwise and the Gaussian blur kernel is symmetric."""
     y0, x0, ch, cw = _rrc_params(key, extent[0], extent[1], cfg)
+    rot = extent[2] > 0
+    if flip_key is not None and cfg.flip_prob > 0:
+        flip = jax.random.uniform(flip_key, ()) < cfg.flip_prob
+    else:
+        flip = jnp.asarray(False)
+    # a horizontal flip of the FINAL image flips the staged W axis for
+    # normal samples, but the staged H axis for rot-staged (transposed) ones
+    flip_v = jnp.logical_and(flip, rot)
+    flip_h = jnp.logical_and(flip, jnp.logical_not(rot))
     # crop+resize as two dense matmuls (MXU) instead of gather-based
     # `scale_and_translate` — measured ~5x faster on the v5e for the same
     # separable triangle-filter math (see ops/matmul_resize.py)
@@ -367,8 +402,9 @@ def _random_resized_crop(img, key, cfg: AugConfig, extent):
         img, y0, x0, ch, cw, cfg.out_size, antialias=True,
         valid_h=jnp.asarray(extent[0], jnp.float32),
         valid_w=jnp.asarray(extent[1], jnp.float32),
+        flip_v=flip_v, flip_h=flip_h,
     )
-    return jnp.where(extent[2] > 0, jnp.swapaxes(out, 0, 1), out)
+    return jnp.where(rot, jnp.swapaxes(out, 0, 1), out)
 
 
 def _random_solarize(img, key, cfg: AugConfig):
@@ -378,15 +414,12 @@ def _random_solarize(img, key, cfg: AugConfig):
     return jnp.where(apply, sol, img)
 
 
-def _random_flip(img, key, cfg: AugConfig):
-    apply = jax.random.uniform(key, ()) < cfg.flip_prob
-    return jnp.where(apply, img[:, ::-1, :], img)
-
-
 def _augment_one(img_u8, key, extent, cfg: AugConfig, skip_blur: bool = False):
-    img = img_u8.astype(jnp.float32) / 255.0
+    dt = jnp.dtype(cfg.dtype)
+    img = img_u8.astype(dt) / dt.type(255.0)
     kcrop, kjit, kgray, kblur, kflip, ksol = jax.random.split(key, 6)
-    img = _random_resized_crop(img, kcrop, cfg, extent)
+    # flip is folded into the crop's resample matrix (see _random_resized_crop)
+    img = _random_resized_crop(img, kcrop, cfg, extent, flip_key=kflip)
     if cfg.grayscale_first:
         # v1 order (`main_moco.py:≈L232-244`): grayscale precedes jitter —
         # saturation/hue jitter on an already-gray image is a no-op, so the
@@ -404,8 +437,7 @@ def _augment_one(img_u8, key, extent, cfg: AugConfig, skip_blur: bool = False):
         img = _gaussian_blur(img, kblur, cfg)
     if cfg.solarize_prob > 0:
         img = _random_solarize(img, ksol, cfg)
-    img = _random_flip(img, kflip, cfg)
-    return (img - IMAGENET_MEAN) / IMAGENET_STD
+    return (img - IMAGENET_MEAN.astype(dt)) * IMAGENET_INV_STD.astype(dt)
 
 
 def _use_pallas_blur(cfg: AugConfig) -> bool:

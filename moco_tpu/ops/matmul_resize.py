@@ -48,7 +48,7 @@ def interp_matrix(
 
 
 def crop_resize(
-    img: jax.Array,  # [H, W, C] float32
+    img: jax.Array,  # [H, W, C] float (pipeline dtype)
     y0,
     x0,
     crop_h,
@@ -57,10 +57,27 @@ def crop_resize(
     antialias: bool = True,
     valid_h=None,
     valid_w=None,
+    flip_v=None,
+    flip_h=None,
 ) -> jax.Array:
-    """Resample the box [y0:y0+crop_h, x0:x0+crop_w] to [out, out, C]."""
+    """Resample the box [y0:y0+crop_h, x0:x0+crop_w] to [out, out, C].
+
+    `flip_v`/`flip_h` (traced bools) reverse the output rows/columns by
+    reversing the interpolation-matrix rows — a free flip (the reversal
+    touches a [out, src] matrix, not the image)."""
     rv = interp_matrix(img.shape[0], out_size, y0, crop_h, antialias, valid_h)
     rh = interp_matrix(img.shape[1], out_size, x0, crop_w, antialias, valid_w)
+    if flip_v is not None:
+        rv = jnp.where(flip_v, rv[::-1], rv)
+    if flip_h is not None:
+        rh = jnp.where(flip_h, rh[::-1], rh)
+    # matrices in the image dtype: a bf16 pipeline then runs both
+    # contractions natively on the MXU (weight quantization ~2^-8 ≈ the u8
+    # source precision); accumulation stays f32
+    rv = rv.astype(img.dtype)
+    rh = rh.astype(img.dtype)
     # [O,H]x[H,W,C] then [O,W,C]x[W,O'] — two dense contractions on the MXU
     tmp = jnp.einsum("oh,hwc->owc", rv, img, preferred_element_type=jnp.float32)
-    return jnp.einsum("pw,owc->opc", rh, tmp, preferred_element_type=jnp.float32)
+    return jnp.einsum(
+        "pw,owc->opc", rh, tmp.astype(img.dtype), preferred_element_type=jnp.float32
+    ).astype(img.dtype)

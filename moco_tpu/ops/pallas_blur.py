@@ -34,10 +34,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 def _blur_kernel(img_ref, kern_ref, out_ref):
     """One sample. img_ref: [3, H+2R, W+2R] edge-padded; kern_ref: [1, 2R+1]
-    (SMEM); out_ref: [3, H, W]."""
+    (SMEM); out_ref: [3, H, W]. Accumulates in f32 whatever the I/O dtype."""
     taps = kern_ref.shape[-1]
     h, w = out_ref.shape[1], out_ref.shape[2]
-    x = img_ref[...]  # [3, H+2R, W+2R] in VMEM
+    x = img_ref[...].astype(jnp.float32)  # [3, H+2R, W+2R] in VMEM
     # H pass: shift along sublanes
     acc = jnp.zeros((3, h, x.shape[2]), jnp.float32)
     for j in range(taps):
@@ -47,17 +47,18 @@ def _blur_kernel(img_ref, kern_ref, out_ref):
     acc2 = jnp.zeros((3, w, h), jnp.float32)
     for j in range(taps):
         acc2 = acc2 + kern_ref[0, j] * t[:, j : j + w, :]
-    out_ref[...] = jnp.transpose(acc2, (0, 2, 1))  # [3, H, W]
+    out_ref[...] = jnp.transpose(acc2, (0, 2, 1)).astype(out_ref.dtype)  # [3, H, W]
 
 
 @functools.partial(jax.jit, static_argnames=("radius", "interpret"))
 def gaussian_blur_batch(
-    images: jax.Array,   # [B, H, W, 3] float32 (NHWC, the pipeline layout)
+    images: jax.Array,   # [B, H, W, 3] float (NHWC, the pipeline dtype)
     kernels: jax.Array,  # [B, 2R+1] per-sample normalized tap weights
     radius: int,
     interpret: bool = False,
 ) -> jax.Array:
-    """Apply each sample's separable kernel to its image; returns NHWC."""
+    """Apply each sample's separable kernel to its image; returns NHWC in
+    the input dtype (f32 accumulation inside the kernel)."""
     b, h, w, _ = images.shape
     taps = 2 * radius + 1
     assert kernels.shape == (b, taps), (kernels.shape, (b, taps))
@@ -73,7 +74,7 @@ def gaussian_blur_batch(
         vma = getattr(getattr(img_padded, "aval", None), "vma", frozenset())
         return pl.pallas_call(
             _blur_kernel,
-            out_shape=jax.ShapeDtypeStruct((3, h, w), jnp.float32, vma=vma),
+            out_shape=jax.ShapeDtypeStruct((3, h, w), images.dtype, vma=vma),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.VMEM),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -82,7 +83,7 @@ def gaussian_blur_batch(
             interpret=interpret,
         )(img_padded, kern.reshape(1, taps))
 
-    out = jax.vmap(one)(padded.astype(jnp.float32), kernels.astype(jnp.float32))
+    out = jax.vmap(one)(padded, kernels.astype(jnp.float32))
     return jnp.transpose(out, (0, 2, 3, 1))
 
 

@@ -137,8 +137,15 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
         aug_cfg = v2_aug_config(config.image_size)
     else:
         aug_cfg = v1_aug_config(config.image_size)
+    # image pipeline in the model's compute dtype: bf16 halves the aug's HBM
+    # traffic on TPU (the encoder casts to bf16 immediately anyway)
+    from moco_tpu.data.augment import with_dtype
+    from moco_tpu.train_step import build_fused_step
+
+    aug_cfg = with_dtype(aug_cfg, config.compute_dtype)
     data_key = jax.random.key(config.seed + 1)
     two_crops_fn = build_two_crops_sharded(aug_cfg, mesh)
+    fused_step = build_fused_step(step_fn, two_crops_fn, data_key)
 
     # host-side step counter mirroring state.step: int(state.step) would be a
     # device→host sync (~70 ms on the relay) serializing every iteration
@@ -188,10 +195,8 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
                     if i >= steps_per_epoch:  # steps_per_epoch may cap the epoch
                         break
                     data_time.update(time.perf_counter() - end)
-                    step_key = jax.random.fold_in(data_key, global_step)
-                    im_q, im_k = two_crops_fn(imgs, step_key, extents)
                     profiler.maybe_toggle(global_step)
-                    state, metrics = step_fn(state, im_q, im_k)
+                    state, metrics = fused_step(state, imgs, extents, global_step)
                     global_step += 1
                     if i % config.print_freq == 0:
                         # pull metrics (host sync) only when printing
