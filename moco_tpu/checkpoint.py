@@ -52,20 +52,36 @@ def save_checkpoint(mgr: ocp.CheckpointManager, state: TrainState, step: int) ->
 
 
 def restore_checkpoint(
-    mgr: ocp.CheckpointManager, abstract_state: TrainState, step: int | None = None
+    mgr: ocp.CheckpointManager,
+    abstract_state: TrainState,
+    step: int | None = None,
+    sharding=None,
 ) -> TrainState:
     """Restore `step` (or the latest). `abstract_state` provides the pytree
-    structure/shardings — pass a freshly-created state."""
+    structure — pass a freshly-created state. With `sharding` (e.g. the
+    mesh-replicated NamedSharding), Orbax restores DIRECTLY into that
+    placement via ShapeDtypeStructs — each host reads its own shards, which
+    is the only correct route on multi-process meshes (a restore-then-
+    `device_put` would need cross-host transfers)."""
     if step is None:
         step = mgr.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint found to resume from")
-    restored = mgr.restore(step, args=ocp.args.StandardRestore(_unkey(abstract_state)))
+    target = _unkey(abstract_state)
+    if sharding is not None:
+        import jax.numpy as jnp
+
+        def to_abstract(x):
+            x = jnp.asarray(x)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+        target = jax.tree.map(to_abstract, target)
+    restored = mgr.restore(step, args=ocp.args.StandardRestore(target))
     return _rekey(restored)
 
 
 def maybe_resume(
-    mgr: ocp.CheckpointManager, state: TrainState, resume: str
+    mgr: ocp.CheckpointManager, state: TrainState, resume: str, sharding=None
 ) -> TrainState:
     """`resume == "auto"`: latest if any (fresh state otherwise);
     `resume == ""`: fresh; an integer: that step in `mgr`'s directory; a
@@ -76,9 +92,9 @@ def maybe_resume(
     if resume == "auto":
         if mgr.latest_step() is None:
             return state
-        return restore_checkpoint(mgr, state)
+        return restore_checkpoint(mgr, state, sharding=sharding)
     if resume.isdigit():
-        return restore_checkpoint(mgr, state, int(resume))
+        return restore_checkpoint(mgr, state, int(resume), sharding=sharding)
     # path form: .../<ckpt_dir>/<step>
     path = os.path.normpath(resume)
     base = os.path.basename(path)
@@ -88,7 +104,7 @@ def maybe_resume(
             f"step directory; got {resume!r}"
         )
     other = checkpoint_manager(os.path.dirname(path))
-    return restore_checkpoint(other, state, int(base))
+    return restore_checkpoint(other, state, int(base), sharding=sharding)
 
 
 # ---------------------------------------------------------------------------
@@ -240,32 +256,191 @@ def export_backbone_tree(
     return flat
 
 
-def export_v3_backbone(state: TrainState, path: str) -> dict[str, np.ndarray]:
+def _vit_grid(params: dict, image_size: int) -> tuple[int, int]:
+    """Patch grid for the timm `pos_embed` buffer, from the patch-embed
+    kernel's own patch size and the training resolution."""
+    p = int(np.asarray(params["patch_embed"]["kernel"]).shape[0])
+    return (image_size // p, image_size // p)
+
+
+def export_v3_backbone(
+    state: TrainState, path: str, image_size: int = 224
+) -> dict[str, np.ndarray]:
     """MoCo-v3 query BACKBONE export (predictor/projector dropped — the v3
-    lincls protocol probes backbone features)."""
+    lincls protocol probes backbone features). ViT backbones are written in
+    the PUBLIC timm dialect (`blocks.N.*`) so external harnesses — timm's
+    `load_state_dict`, the moco-v3 lincls surgery — can consume a v3
+    pretrain directly (VERDICT r1 #6); ResNet v3 backbones keep the tree
+    dialect (their public dialect is the v1/v2 `module.encoder_q.*` export,
+    which expects the contrastive fc this state doesn't have)."""
+    params = state.params_q["backbone"]
+    if "patch_embed" in params:  # ViT backbone
+        flat = vit_to_timm(
+            jax.tree.map(np.asarray, params), grid=_vit_grid(params, image_size)
+        )
+        _save_flat(flat, path)
+        return flat
     return export_backbone_tree(
-        state.params_q["backbone"],
+        params,
         state.batch_stats_q.get("backbone", {}),
         path,
     )
 
 
-def export_vit_encoder(state: TrainState, path: str) -> dict[str, np.ndarray]:
-    """v1/v2 export for ViT encoders (contrastive `head` dropped; ViT has no
-    torchvision dialect, so it uses the tree dialect)."""
+def export_vit_encoder(
+    state: TrainState, path: str, image_size: int = 224
+) -> dict[str, np.ndarray]:
+    """v1/v2 export for ViT encoders: timm dialect for the backbone (public,
+    consumable by timm/moco-v3 tooling) with the contrastive `head` dropped."""
     params = {k: v for k, v in state.params_q.items() if k != "head"}
-    return export_backbone_tree(params, state.batch_stats_q, path)
+    flat = vit_to_timm(
+        jax.tree.map(np.asarray, params), grid=_vit_grid(params, image_size)
+    )
+    _save_flat(flat, path)
+    return flat
 
 
-def load_pretrained_backbone(path: str) -> tuple[dict, dict]:
+# ---------------------------------------------------------------------------
+# timm-dialect ViT export (the public ViT checkpoint naming)
+# ---------------------------------------------------------------------------
+
+
+def _sincos_pos_embed_np(gh: int, gw: int, dim: int) -> np.ndarray:
+    """timm-style `pos_embed` [1, 1+gh*gw, dim]: zero class-token row +
+    the fixed 2-D sin-cos grid (moco-v3's
+    `build_2d_sincos_position_embedding` emits exactly this buffer —
+    `pe_token = zeros([1,1,D])` concatenated before the grid)."""
+    from moco_tpu.models.vit import sincos_2d_position_embedding
+
+    grid = np.asarray(sincos_2d_position_embedding(gh, gw, dim))
+    return np.concatenate([np.zeros((1, 1, dim), np.float32), grid], axis=1)
+
+
+def vit_to_timm(params: dict, prefix: str = "", grid: tuple[int, int] = (14, 14)) -> dict[str, np.ndarray]:
+    """Flatten a moco_tpu ViT param tree to timm `VisionTransformer`
+    state_dict names (`cls_token`, `pos_embed`, `patch_embed.proj.*`,
+    `blocks.N.{norm1,attn.qkv,attn.proj,norm2,mlp.fc1,mlp.fc2}.*`, `norm.*`)
+    — the dialect moco-v3's ViT checkpoints speak (its `vits.py` subclasses
+    timm's `VisionTransformer`), so exported v3 pretrains are consumable by
+    any timm-based harness. `pos_embed` is our fixed sin-cos buffer
+    (parameter-free in the model; emitted because the dialect expects it).
+    """
+    width = int(params["cls_token"].shape[-1])
+    out: dict[str, np.ndarray] = {
+        f"{prefix}cls_token": np.asarray(params["cls_token"], np.float32),
+        f"{prefix}pos_embed": _sincos_pos_embed_np(grid[0], grid[1], width),
+    }
+    out.update(_conv_entry(f"{prefix}patch_embed.proj", params["patch_embed"]))
+    out[f"{prefix}patch_embed.proj.bias"] = np.asarray(params["patch_embed"]["bias"])
+    blocks = sorted(
+        (int(k[len("block"):]), k) for k in params if k.startswith("block")
+    )
+    for i, name in blocks:
+        blk = params[name]
+        bp = f"{prefix}blocks.{i}"
+        for ln, tn in (("norm1", "norm1"), ("norm2", "norm2")):
+            out[f"{bp}.{tn}.weight"] = np.asarray(blk[ln]["scale"])
+            out[f"{bp}.{tn}.bias"] = np.asarray(blk[ln]["bias"])
+        attn = blk["attn"]
+        # flax q/k/v kernels [D, H, hd] → torch rows h*hd+d: reshape+T;
+        # stacked [q;k;v] like timm's fused qkv Linear
+        qkv_w = [
+            np.ascontiguousarray(np.asarray(attn[m]["kernel"]).reshape(width, width).T)
+            for m in ("query", "key", "value")
+        ]
+        qkv_b = [np.asarray(attn[m]["bias"]).reshape(width) for m in ("query", "key", "value")]
+        out[f"{bp}.attn.qkv.weight"] = np.concatenate(qkv_w, axis=0)
+        out[f"{bp}.attn.qkv.bias"] = np.concatenate(qkv_b, axis=0)
+        out[f"{bp}.attn.proj.weight"] = np.ascontiguousarray(
+            np.asarray(attn["out"]["kernel"]).reshape(width, width).T
+        )
+        out[f"{bp}.attn.proj.bias"] = np.asarray(attn["out"]["bias"])
+        out.update(_dense_entries(f"{bp}.mlp.fc1", blk["mlp_fc1"]))
+        out.update(_dense_entries(f"{bp}.mlp.fc2", blk["mlp_fc2"]))
+    out[f"{prefix}norm.weight"] = np.asarray(params["norm"]["scale"])
+    out[f"{prefix}norm.bias"] = np.asarray(params["norm"]["bias"])
+    return out
+
+
+def timm_to_vit(
+    flat: dict[str, np.ndarray], num_heads: int = 12, prefix: str = ""
+) -> dict:
+    """Inverse of `vit_to_timm`: rebuild the flax ViT param tree from a
+    timm-dialect checkpoint (ours, or any timm ViT with fused qkv).
+    `num_heads` splits the fused qkv back into flax's [D, H, hd] kernels —
+    12 for every moco-v3 arch (its `vits.py` uses head dim 32 throughout).
+    `pos_embed`/`head.*` entries are ignored (fixed sin-cos buffer / probe
+    head, not backbone params)."""
+    width = int(flat[f"{prefix}cls_token"].shape[-1])
+    hd = width // num_heads
+    tree: dict = {
+        "cls_token": np.asarray(flat[f"{prefix}cls_token"]),
+        "patch_embed": {
+            "kernel": np.asarray(flat[f"{prefix}patch_embed.proj.weight"]).transpose(2, 3, 1, 0),
+            "bias": np.asarray(flat[f"{prefix}patch_embed.proj.bias"]),
+        },
+        "norm": {
+            "scale": np.asarray(flat[f"{prefix}norm.weight"]),
+            "bias": np.asarray(flat[f"{prefix}norm.bias"]),
+        },
+    }
+    n_blocks = 1 + max(
+        int(k[len(prefix):].split(".")[1])
+        for k in flat
+        if k.startswith(f"{prefix}blocks.")
+    )
+    for i in range(n_blocks):
+        bp = f"{prefix}blocks.{i}"
+        qkv_w = np.asarray(flat[f"{bp}.attn.qkv.weight"])
+        qkv_b = np.asarray(flat[f"{bp}.attn.qkv.bias"])
+        attn: dict = {}
+        for j, m in enumerate(("query", "key", "value")):
+            w = qkv_w[j * width:(j + 1) * width]  # [D_out, D_in]
+            b = qkv_b[j * width:(j + 1) * width]
+            attn[m] = {
+                "kernel": np.ascontiguousarray(w.T).reshape(width, num_heads, hd),
+                "bias": b.reshape(num_heads, hd),
+            }
+        attn["out"] = {
+            "kernel": np.ascontiguousarray(
+                np.asarray(flat[f"{bp}.attn.proj.weight"]).T
+            ).reshape(num_heads, hd, width),
+            "bias": np.asarray(flat[f"{bp}.attn.proj.bias"]),
+        }
+        tree[f"block{i}"] = {
+            "norm1": {
+                "scale": np.asarray(flat[f"{bp}.norm1.weight"]),
+                "bias": np.asarray(flat[f"{bp}.norm1.bias"]),
+            },
+            "norm2": {
+                "scale": np.asarray(flat[f"{bp}.norm2.weight"]),
+                "bias": np.asarray(flat[f"{bp}.norm2.bias"]),
+            },
+            "attn": attn,
+            "mlp_fc1": {
+                "kernel": np.ascontiguousarray(np.asarray(flat[f"{bp}.mlp.fc1.weight"]).T),
+                "bias": np.asarray(flat[f"{bp}.mlp.fc1.bias"]),
+            },
+            "mlp_fc2": {
+                "kernel": np.ascontiguousarray(np.asarray(flat[f"{bp}.mlp.fc2.weight"]).T),
+                "bias": np.asarray(flat[f"{bp}.mlp.fc2.bias"]),
+            },
+        }
+    return tree
+
+
+def load_pretrained_backbone(path: str, num_heads: int = 12) -> tuple[dict, dict]:
     """Dialect-routed load of a pretrained backbone: torchvision
-    `module.encoder_q.*` (v1/v2 ResNet, head dropped) or `backbone/*` trees
-    (ViT / v3). Returns (params, batch_stats) as numpy trees."""
+    `module.encoder_q.*` (v1/v2 ResNet, head dropped), timm `blocks.N.*`
+    (ViT — ours or any fused-qkv timm checkpoint), or `backbone/*` trees
+    (v3 ResNet). Returns (params, batch_stats) as numpy trees."""
     flat = import_encoder_q(path)
     if any(k.startswith("backbone/") for k in flat):
         return unflatten_tree(flat, "backbone/"), unflatten_tree(
             flat, "backbone_stats/"
         )
+    if "patch_embed.proj.weight" in flat:
+        return timm_to_vit(flat, num_heads=num_heads), {}
     return torchvision_to_resnet(flat)
 
 

@@ -56,11 +56,15 @@ def load_frozen_backbone(config: EvalConfig):
         from moco_tpu.models.vit import build_vit
 
         model = build_vit(config.arch, num_classes=None)
+        # timm-dialect checkpoints carry a FUSED qkv; split it with THIS
+        # arch's head count (a wrong count mis-partitions heads silently)
+        num_heads = model.num_heads
     else:
         model = build_resnet(
             config.arch, num_classes=None, cifar_stem=config.cifar_stem
         )
-    params, stats = load_pretrained_backbone(config.pretrained)
+        num_heads = 12
+    params, stats = load_pretrained_backbone(config.pretrained, num_heads=num_heads)
     if not params:
         raise ValueError(
             f"no 'module.encoder_q.*' / 'v3_backbone/*' entries found in "
@@ -306,7 +310,9 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
     # reference `sanity_check`: reload the pretrain checkpoint from disk and
     # compare (in this functional design the backbone is structurally
     # immutable, but the check still guards against buffer aliasing bugs)
-    reloaded, _ = load_pretrained_backbone(config.pretrained)
+    reloaded, _ = load_pretrained_backbone(
+        config.pretrained, num_heads=getattr(model, "num_heads", 12)
+    )
     sanity_check(backbone_params, reloaded)
     return fc, best_acc1
 

@@ -66,7 +66,9 @@ class TransformerBlock(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
         y = nn.Dense(int(self.dim * self.mlp_ratio), dtype=self.dtype,
                      param_dtype=jnp.float32, name="mlp_fc1")(y)
-        y = nn.gelu(y)
+        # exact erf GELU — timm/moco-v3's nn.GELU (flax's default is the
+        # tanh approximation, a real if small distributional deviation)
+        y = nn.gelu(y, approximate=False)
         y = nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
                      name="mlp_fc2")(y)
         return x + y

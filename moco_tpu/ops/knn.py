@@ -22,7 +22,7 @@ from jax import lax
 from moco_tpu.ops.losses import l2_normalize
 
 
-@functools.partial(jax.jit, static_argnames=("num_classes", "k"))
+@functools.partial(jax.jit, static_argnames=("num_classes", "k", "bank_chunk"))
 def _knn_predict_prenormalized(
     feats: jax.Array,         # [B, dim] L2-normalized queries
     bank: jax.Array,          # [N, dim] L2-normalized bank
@@ -30,12 +30,56 @@ def _knn_predict_prenormalized(
     num_classes: int,
     k: int = 200,
     temperature: float = 0.07,
+    bank_chunk: int | None = None,
 ) -> jax.Array:
-    sims = jnp.einsum("bc,nc->bn", feats, bank, preferred_element_type=jnp.float32)
-    k = min(k, bank.shape[0])
-    top_sims, top_idx = lax.top_k(sims, k)                      # [B, k]
+    """`bank_chunk` streams the bank through a `lax.scan`, carrying a running
+    top-k merge, so peak live memory is `[B, bank_chunk]` sims + `[B, 2k]`
+    merge instead of the full `[B, N]` similarity matrix — the ImageNet-scale
+    path (N=1.28M: a [512, 1.28M] f32 matrix is 2.6 GB and `top_k` over 1.28M
+    columns is the slow/hungry op; chunked at 64k it is 134 MB/step and 20
+    cheap top-ks). Exact: per-chunk top-k ∪ running top-k ⊇ global top-k."""
+    n = bank.shape[0]
+    if bank_chunk is None or bank_chunk >= n:
+        sims = jnp.einsum("bc,nc->bn", feats, bank, preferred_element_type=jnp.float32)
+        k = min(k, n)
+        top_sims, top_idx = lax.top_k(sims, k)                  # [B, k]
+        neigh_labels = bank_labels[top_idx]                     # [B, k]
+    else:
+        k = min(k, bank_chunk)
+        b = feats.shape[0]
+        n_chunks = -(-n // bank_chunk)
+        pad = n_chunks * bank_chunk - n
+        bank = jnp.pad(bank, ((0, pad), (0, 0)))
+        # padded rows have sim 0 to everything; push them below any real
+        # neighbor with a -inf sentinel so they never out-rank real rows
+        valid = jnp.pad(jnp.ones((n,), jnp.float32), (0, pad),
+                        constant_values=-jnp.inf)
+        bank_labels = jnp.pad(bank_labels, (0, pad))
+        chunks = bank.reshape(n_chunks, bank_chunk, -1)
+        label_chunks = bank_labels.reshape(n_chunks, bank_chunk)
+        valid_chunks = valid.reshape(n_chunks, bank_chunk)
+
+        def merge(carry, chunk):
+            best_s, best_l = carry
+            cb, cl, cv = chunk
+            sims = jnp.einsum("bc,nc->bn", feats, cb,
+                              preferred_element_type=jnp.float32)
+            sims = jnp.minimum(sims, cv[None, :])   # -inf on padded rows
+            top_s, top_i = lax.top_k(sims, k)
+            cand_s = jnp.concatenate([best_s, top_s], axis=1)       # [B, 2k]
+            cand_l = jnp.concatenate([best_l, cl[top_i]], axis=1)
+            best_s, sel = lax.top_k(cand_s, k)
+            best_l = jnp.take_along_axis(cand_l, sel, axis=1)
+            return (best_s, best_l), None
+
+        init = (
+            jnp.full((b, k), -jnp.inf, jnp.float32),
+            jnp.zeros((b, k), bank_labels.dtype),
+        )
+        (top_sims, neigh_labels), _ = lax.scan(
+            merge, init, (chunks, label_chunks, valid_chunks)
+        )
     weights = jnp.exp(top_sims / temperature)
-    neigh_labels = bank_labels[top_idx]                          # [B, k]
     onehot = jax.nn.one_hot(neigh_labels, num_classes, dtype=jnp.float32)
     votes = jnp.einsum("bk,bkc->bc", weights, onehot)
     return jnp.argmax(votes, axis=-1)
@@ -48,6 +92,7 @@ def knn_predict(
     num_classes: int,
     k: int = 200,
     temperature: float = 0.07,
+    bank_chunk: int | None = None,
 ) -> jax.Array:
     """Return predicted class ids `[B]` (normalizes both sides; for repeated
     calls against the same bank use `knn_accuracy`, which normalizes once)."""
@@ -58,6 +103,7 @@ def knn_predict(
         num_classes,
         k=k,
         temperature=temperature,
+        bank_chunk=bank_chunk,
     )
 
 
@@ -70,11 +116,15 @@ def knn_accuracy(
     k: int = 200,
     temperature: float = 0.07,
     batch: int = 512,
+    bank_chunk: int | None = 65536,
 ) -> float:
-    """Top-1 kNN accuracy, evaluated in fixed-size batches so the similarity
-    matrix never exceeds `[batch, N_bank]` in HBM. The bank is normalized
-    ONCE, and the ragged final batch is padded to `batch` rows so the jitted
-    kernel compiles exactly once."""
+    """Top-1 kNN accuracy, evaluated in fixed-size query batches with the
+    bank streamed in `bank_chunk` slices, so peak HBM is
+    `[batch, bank_chunk]` sims + the `[N_bank, dim]` bank itself — at
+    ImageNet scale (1.28M × 128 f32 bank = 655 MB, chunk sims = 134 MB)
+    comfortably inside one chip's 16 GB. The bank is normalized ONCE, and
+    the ragged final batch is padded to `batch` rows so the jitted kernel
+    compiles exactly once."""
     n = features.shape[0]
     feats = l2_normalize(jnp.asarray(features, jnp.float32))
     bank = l2_normalize(jnp.asarray(bank, jnp.float32))
@@ -86,7 +136,8 @@ def knn_accuracy(
         if valid < batch:
             f = jnp.pad(f, ((0, batch - valid), (0, 0)))
         pred = _knn_predict_prenormalized(
-            f, bank, bank_labels, num_classes, k=k, temperature=temperature
+            f, bank, bank_labels, num_classes, k=k, temperature=temperature,
+            bank_chunk=bank_chunk,
         )
         correct += int(jnp.sum(pred[:valid] == y))
     return correct / n

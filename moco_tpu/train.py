@@ -121,12 +121,13 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
 
     mgr = checkpoint_manager(config.ckpt_dir) if config.ckpt_dir else None
     if mgr is not None and config.resume:
-        state = maybe_resume(mgr, state, config.resume)
-        # Orbax restores onto the default device; re-place as replicated
-        # across the mesh so the SPMD step sees consistent shardings
+        # restore straight into the mesh-replicated sharding: Orbax places
+        # every host's shards locally (a restore-then-`device_put` would
+        # need cross-host transfers, unsupported on multi-process CPU and a
+        # DCN round-trip on real pods)
         from moco_tpu.parallel.mesh import replicated
 
-        state = jax.device_put(state, replicated(mesh))
+        state = maybe_resume(mgr, state, config.resume, sharding=replicated(mesh))
 
     if config.variant == "v3":
         # asymmetric view pair; crop_min is the repo's --crop-min knob
@@ -253,11 +254,11 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
         if config.variant == "v3":
             from moco_tpu.checkpoint import export_v3_backbone
 
-            export_v3_backbone(state, config.export_path)
+            export_v3_backbone(state, config.export_path, config.image_size)
         elif config.arch.startswith("vit"):
             from moco_tpu.checkpoint import export_vit_encoder
 
-            export_vit_encoder(state, config.export_path)
+            export_vit_encoder(state, config.export_path, config.image_size)
         else:
             from moco_tpu.checkpoint import export_encoder_q
 

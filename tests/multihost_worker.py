@@ -1,12 +1,26 @@
-"""Worker for the 2-process multi-host simulation test (SURVEY §4 item 4).
+"""Worker for the 2-process multi-host simulation tests (SURVEY §4 item 4).
 
 Launched by tests/test_multihost.py as:
-    python tests/multihost_worker.py <coordinator> <num_procs> <pid> <ckpt_dir>
+    python tests/multihost_worker.py <coordinator> <num_procs> <pid> \
+        <ckpt_dir> <mode> <phase>
 
 Each process owns 4 fake CPU devices → a global 8-device data mesh across 2
-"hosts". Runs 3 steps of the real v1 train step with the real host-sharded
-input path, saves a collective Orbax checkpoint, and prints digests of the
-replicated state — the parent asserts both processes agree bit-for-bit.
+"hosts". Unlike round 1's hand-rolled loop, this drives the REAL train
+driver (`moco_tpu.train.train`): host-sharded epoch loader, the SHARDED
+two-crop augmentation (`build_two_crops_sharded` inside the fused step),
+the SPMD train step's collectives across the process boundary, and
+COLLECTIVE Orbax checkpointing.
+
+Modes (VERDICT r1 #7):
+    v2       — MoCo-v2 path: aug_plus two-crop aug, MLP head, queue + ShuffleBN
+    v3       — MoCo-v3 path: asymmetric aug pair, symmetric loss, AdamW +
+               warmup + momentum ramp (no queue)
+Phases:
+    train    — run 6 driver steps, save a collective checkpoint, print the
+               full-state digest
+    restore  — FRESH session: rebuild an (differently-seeded) state, restore
+               the checkpoint, print the digest — the parent asserts it is
+               bit-identical to what the train phase saved
 """
 
 import hashlib
@@ -15,9 +29,49 @@ import sys
 import numpy as np
 
 
+def full_state_digest(state) -> str:
+    """sha256 over every leaf of the state (rng as raw key data), using this
+    process's local shard of each (replicated) array."""
+    import jax
+
+    st = state.replace(rng=jax.random.key_data(state.rng))
+    h = hashlib.sha256()
+    for path, leaf in sorted(
+        jax.tree_util.tree_leaves_with_path(st),
+        key=lambda kv: jax.tree_util.keystr(kv[0]),
+    ):
+        h.update(jax.tree_util.keystr(path).encode())
+        arr = leaf.addressable_shards[0].data if hasattr(leaf, "addressable_shards") else leaf
+        h.update(np.ascontiguousarray(np.asarray(arr)).tobytes())
+    return h.hexdigest()[:16]
+
+
+def make_config(mode: str, ckpt_dir: str):
+    from moco_tpu.config import PretrainConfig
+
+    common = dict(
+        arch="resnet_tiny", cifar_stem=True, embed_dim=16, batch_size=16,
+        image_size=8, epochs=2, steps_per_epoch=3, seed=0, ckpt_dir=ckpt_dir,
+        ckpt_every_epochs=2, num_workers=1,
+    )
+    if mode == "v2":
+        return PretrainConfig(
+            variant="v2", aug_plus=True, mlp_head=True, num_negatives=64,
+            temperature=0.2, lr=0.1, cos=True, **common,
+        )
+    if mode == "v3":
+        return PretrainConfig(
+            variant="v3", optimizer="adamw", lr=1e-3, warmup_epochs=1,
+            momentum_ramp=True, momentum_ema=0.99, temperature=1.0,
+            weight_decay=0.1, **common,
+        )
+    raise ValueError(mode)
+
+
 def main():
-    coordinator, num_procs, pid, ckpt_dir = (
+    coordinator, num_procs, pid, ckpt_dir, mode, phase = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+        sys.argv[5], sys.argv[6],
     )
     import os
 
@@ -34,55 +88,50 @@ def main():
     assert jax.process_count() == num_procs
     assert len(jax.devices()) == 4 * num_procs, jax.devices()
 
-    import jax.numpy as jnp
+    config = make_config(mode, ckpt_dir)
 
-    from moco_tpu.checkpoint import checkpoint_manager, save_checkpoint
-    from moco_tpu.config import PretrainConfig
-    from moco_tpu.data.datasets import SyntheticDataset
-    from moco_tpu.data.loader import epoch_loader
-    from moco_tpu.parallel.mesh import create_mesh
+    if phase == "train":
+        from moco_tpu.train import train
+
+        state, metrics = train(config)
+        steps = int(state.step)
+        loss = float(metrics.get("loss", float("nan")))
+        print(
+            f"RESULT pid={pid} steps={steps} loss={loss:.6f} "
+            f"digest={full_state_digest(state)}",
+            flush=True,
+        )
+        return
+
+    # phase == "restore": a fresh session restores the checkpoint the train
+    # phase saved; digest must match what train printed (bit-faithful resume
+    # across a NEW 2-process incarnation, VERDICT r1 #7)
+    from moco_tpu.checkpoint import checkpoint_manager, maybe_resume
+    from moco_tpu.parallel.mesh import create_mesh, replicated
+    from moco_tpu.train_step import build_encoder, build_optimizer
     from moco_tpu.train_state import create_train_state
-    from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
 
-    GLOBAL_B, IMG, DIM, K = 16, 8, 16, 64
-    config = PretrainConfig(
-        variant="v1", arch="resnet_tiny", cifar_stem=True, num_negatives=K,
-        embed_dim=DIM, batch_size=GLOBAL_B, epochs=1, lr=0.1, seed=0,
-    )
     mesh = create_mesh()
     model = build_encoder(config)
-    tx, sched = build_optimizer(config, 4)
-    state = create_train_state(
-        jax.random.key(0), model, tx, (GLOBAL_B // 8, IMG, IMG, 3), K, DIM
-    )
-    step_fn = build_train_step(config, model, tx, mesh, 4, sched)
+    tx, _ = build_optimizer(config, config.steps_per_epoch)
+    local_b = config.batch_size // 8
+    shape = (local_b, config.image_size, config.image_size, 3)
+    if config.variant == "v3":
+        from moco_tpu.v3_step import create_v3_train_state
 
-    dataset = SyntheticDataset(num_samples=64, image_size=IMG, seed=0)
-    loader = epoch_loader(dataset, epoch=0, seed=0, global_batch=GLOBAL_B, mesh=mesh)
-    steps = 0
-    try:
-        for imgs, _labels, _extents in loader:
-            imgs_f32 = imgs.astype(jnp.float32)
-            state, metrics = step_fn(state, imgs_f32, imgs_f32)
-            steps += 1
-            if steps == 3:
-                break
-    finally:
-        loader.close()
-
+        fresh = create_v3_train_state(jax.random.key(999), model, tx, shape)
+    else:
+        fresh = create_train_state(
+            jax.random.key(999), model, tx, shape, config.num_negatives,
+            config.embed_dim,
+        )
     mgr = checkpoint_manager(ckpt_dir)
-    save_checkpoint(mgr, state, steps)  # collective: every process calls it
-    mgr.wait_until_finished()
-
-    # digest the fully-replicated state from THIS process's local shard
-    def digest(x):
-        local = np.asarray(x.addressable_shards[0].data)
-        return hashlib.sha256(np.ascontiguousarray(local).tobytes()).hexdigest()[:16]
-
+    # restore straight into the replicated sharding (host-local shard reads)
+    state = maybe_resume(mgr, fresh, "auto", sharding=replicated(mesh))
+    assert int(state.step) > 0, "restore phase found no checkpoint"
     print(
-        f"RESULT pid={pid} steps={steps} loss={float(metrics['loss']):.6f} "
-        f"queue={digest(state.queue)} ptr={int(state.queue_ptr)} "
-        f"conv1={digest(state.params_q['conv1']['kernel'])}",
+        f"RESULT pid={pid} steps={int(state.step)} loss=0.0 "
+        f"digest={full_state_digest(state)}",
         flush=True,
     )
 

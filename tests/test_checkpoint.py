@@ -95,3 +95,149 @@ def test_conv_layout_transposed():
     assert flat["conv1.weight"].shape == (8, 4, 3, 3)
     back, _ = torchvision_to_resnet({"x.conv1.weight": flat["conv1.weight"]}, "x.")
     np.testing.assert_array_equal(back["conv1"]["kernel"], kernel)
+
+
+# ---------------------------------------------------------------------------
+# timm-dialect ViT export (VERDICT r1 #6: public v3 checkpoint dialect)
+# ---------------------------------------------------------------------------
+
+from moco_tpu.checkpoint import (  # noqa: E402
+    load_pretrained_backbone,
+    timm_to_vit,
+    vit_to_timm,
+)
+from moco_tpu.models.vit import ViT  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny_vit_params():
+    model = ViT(patch_size=4, width=16, depth=2, num_heads=4, num_classes=None)
+    x = jnp.zeros((2, 8, 8, 3), jnp.float32)
+    params = model.init(jax.random.key(3), x, train=False)["params"]
+    return model, params
+
+
+def test_vit_timm_name_set(tiny_vit_params):
+    _, params = tiny_vit_params
+    flat = vit_to_timm(jax.tree.map(np.asarray, params), grid=(2, 2))
+    expected = {"cls_token", "pos_embed", "patch_embed.proj.weight",
+                "patch_embed.proj.bias", "norm.weight", "norm.bias"}
+    for i in range(2):
+        for n in ("norm1.weight", "norm1.bias", "attn.qkv.weight",
+                  "attn.qkv.bias", "attn.proj.weight", "attn.proj.bias",
+                  "norm2.weight", "norm2.bias", "mlp.fc1.weight",
+                  "mlp.fc1.bias", "mlp.fc2.weight", "mlp.fc2.bias"):
+            expected.add(f"blocks.{i}.{n}")
+    assert set(flat) == expected
+    assert flat["blocks.0.attn.qkv.weight"].shape == (48, 16)
+    assert flat["blocks.0.attn.qkv.bias"].shape == (48,)
+    assert flat["patch_embed.proj.weight"].shape == (16, 3, 4, 4)
+    assert flat["pos_embed"].shape == (1, 5, 16)
+    np.testing.assert_array_equal(flat["pos_embed"][0, 0], 0.0)  # cls row
+
+
+def test_vit_timm_roundtrip_and_apply(tiny_vit_params, tmp_path):
+    model, params = tiny_vit_params
+    flat = vit_to_timm(jax.tree.map(np.asarray, params), grid=(2, 2))
+    back = timm_to_vit(flat, num_heads=4)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(back),
+        jax.tree_util.tree_leaves_with_path(params),
+    ):
+        assert jax.tree_util.keystr(pa) == jax.tree_util.keystr(pb)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    x = jax.random.normal(jax.random.key(4), (2, 8, 8, 3))
+    np.testing.assert_allclose(
+        model.apply({"params": back}, x, train=False),
+        model.apply({"params": params}, x, train=False),
+        rtol=1e-6,
+    )
+
+
+def _timm_consumer_forward(flat, img):
+    """Emulate a timm-style torch consumer forward in numpy: patchify via the
+    [D,3,p,p] conv weight, +pos_embed, pre-norm blocks with fused qkv, exact
+    GELU, final norm, cls feature. Verifies the exported tensor LAYOUTS, not
+    just converter self-consistency."""
+    from scipy.special import erf  # via numpy: exact gelu
+
+    def ln(x, w, b, eps=1e-6):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) / np.sqrt(var + eps) * w + b
+
+    W = flat["patch_embed.proj.weight"]  # [D, C, p, p]
+    D, C, p, _ = W.shape
+    B, H, Wd, _ = img.shape
+    gh, gw = H // p, Wd // p
+    patches = img.reshape(B, gh, p, gw, p, C).transpose(0, 1, 3, 5, 2, 4)
+    patches = patches.reshape(B, gh * gw, C * p * p)  # torch (c, ph, pw) order
+    x = patches @ W.reshape(D, C * p * p).T + flat["patch_embed.proj.bias"]
+    cls = np.broadcast_to(flat["cls_token"], (B, 1, D))
+    x = np.concatenate([cls, x], axis=1) + flat["pos_embed"]
+    n_blocks = 1 + max(int(k.split(".")[1]) for k in flat if k.startswith("blocks."))
+    heads = 4
+    hd = D // heads
+    for i in range(n_blocks):
+        bp = f"blocks.{i}"
+        y = ln(x, flat[f"{bp}.norm1.weight"], flat[f"{bp}.norm1.bias"])
+        qkv = y @ flat[f"{bp}.attn.qkv.weight"].T + flat[f"{bp}.attn.qkv.bias"]
+        q, k, v = np.split(qkv, 3, axis=-1)
+        N = q.shape[1]
+
+        def split_heads(t):
+            return t.reshape(B, N, heads, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = split_heads(q), split_heads(k), split_heads(v)
+        att = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd)
+        att = np.exp(att - att.max(-1, keepdims=True))
+        att = att / att.sum(-1, keepdims=True)
+        o = (att @ v).transpose(0, 2, 1, 3).reshape(B, N, D)
+        o = o @ flat[f"{bp}.attn.proj.weight"].T + flat[f"{bp}.attn.proj.bias"]
+        x = x + o
+        y = ln(x, flat[f"{bp}.norm2.weight"], flat[f"{bp}.norm2.bias"])
+        y = y @ flat[f"{bp}.mlp.fc1.weight"].T + flat[f"{bp}.mlp.fc1.bias"]
+        y = 0.5 * y * (1.0 + erf(y / np.sqrt(2.0)))
+        y = y @ flat[f"{bp}.mlp.fc2.weight"].T + flat[f"{bp}.mlp.fc2.bias"]
+        x = x + y
+    x = ln(x, flat["norm.weight"], flat["norm.bias"])
+    return x[:, 0]
+
+
+def test_vit_timm_export_matches_external_consumer(tiny_vit_params):
+    """A torch/timm consumer computing from the exported tensors gets the
+    same features our model computes — the layout (transposes, qkv fusion,
+    head packing, patch order, pos_embed) is externally correct."""
+    model, params = tiny_vit_params
+    flat = vit_to_timm(jax.tree.map(np.asarray, params), grid=(2, 2))
+    img = np.asarray(jax.random.normal(jax.random.key(5), (2, 8, 8, 3)))
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(img), train=False))
+    theirs = _timm_consumer_forward(flat, img.astype(np.float64))
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-5)
+
+
+def test_v3_vit_export_is_timm_dialect(tmp_path):
+    from moco_tpu.checkpoint import export_v3_backbone
+    from moco_tpu.v3_step import V3Model
+
+    model = V3Model(
+        ViT(patch_size=4, width=16, depth=2, num_heads=4, num_classes=None),
+        embed_dim=8,
+        hidden_dim=16,
+    )
+    state = create_train_state(jax.random.key(0), model, optax.sgd(0.1),
+                               (2, 8, 8, 3), None, 8)
+    path = str(tmp_path / "v3_vit.safetensors")
+    flat = export_v3_backbone(state, path, image_size=8)
+    assert "blocks.0.attn.qkv.weight" in flat
+    assert "backbone/patch_embed/kernel" not in flat
+    # pos_embed follows the MODEL's grid (8px / patch 4 -> 2x2 + cls)
+    assert flat["pos_embed"].shape == (1, 5, 16)
+    params, stats = load_pretrained_backbone(path, num_heads=4)
+    assert stats == {}
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(state.params_q["backbone"]),
+    ):
+        assert jax.tree_util.keystr(pa) == jax.tree_util.keystr(pb)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,9 +1,11 @@
-"""True multi-process multi-host simulation (SURVEY §4 item 4): two JAX
-processes x 4 fake CPU devices = one 8-device mesh across 2 "hosts",
-exercising `jax.distributed` bootstrap, host-sharded input assembly
-(`make_array_from_process_local_data`), the SPMD step's collectives across
-process boundaries, and COLLECTIVE Orbax checkpointing. The parent asserts
-both processes end with bit-identical replicated state."""
+"""True multi-process multi-host simulation (SURVEY §4 item 4; VERDICT r1
+#7): two JAX processes x 4 fake CPU devices = one 8-device mesh across 2
+"hosts", driving the REAL train driver — `jax.distributed` bootstrap,
+host-sharded input assembly, the SHARDED two-crop augmentation, the SPMD
+step's collectives across process boundaries, and COLLECTIVE Orbax
+checkpointing — for both the v2 (queue + ShuffleBN) and v3 (symmetric,
+queue-free) paths. A separate FRESH 2-process session then restores the v2
+checkpoint and must reproduce the saved state bit-for-bit."""
 
 import os
 import re
@@ -20,16 +22,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_training_agrees(tmp_path):
-    port = _free_port()
-    coordinator = f"127.0.0.1:{port}"
-    ckpt_dir = str(tmp_path / "ckpt")
+def _run_pair(ckpt_dir: str, mode: str, phase: str) -> dict[int, tuple]:
+    """Launch 2 workers, return {pid: (steps, loss, digest)}."""
+    coordinator = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["PYTHONPATH"] = os.getcwd()
     procs = [
         subprocess.Popen(
-            [sys.executable, "tests/multihost_worker.py", coordinator, "2", str(pid), ckpt_dir],
+            [sys.executable, "tests/multihost_worker.py", coordinator, "2",
+             str(pid), ckpt_dir, mode, phase],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -42,18 +43,41 @@ def test_two_process_training_agrees(tmp_path):
         out, _ = p.communicate(timeout=600)
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert p.returncode == 0, f"{mode}/{phase} worker {pid} failed:\n{out[-3000:]}"
     results = {}
     for out in outs:
         m = re.search(
-            r"RESULT pid=(\d+) steps=(\d+) loss=([\d.]+) queue=(\w+) ptr=(\d+) conv1=(\w+)",
-            out,
+            r"RESULT pid=(\d+) steps=(\d+) loss=([\d.nan]+) digest=(\w+)", out
         )
         assert m, f"no RESULT line in:\n{out[-3000:]}"
-        results[int(m.group(1))] = m.groups()[1:]
+        results[int(m.group(1))] = (m.group(2), m.group(3), m.group(4))
+    return results
+
+
+@pytest.mark.slow
+def test_two_process_v2_train_restore_bitfaithful(tmp_path):
+    """v2 (sharded aug + queue + ShuffleBN): replicas agree bit-for-bit after
+    6 driver steps, and a FRESH 2-process session restores the checkpoint to
+    exactly the trained state."""
+    ckpt_dir = str(tmp_path / "ckpt_v2")
+    trained = _run_pair(ckpt_dir, "v2", "train")
+    assert trained[0] == trained[1], f"process state diverged: {trained}"
+    assert trained[0][0] == "6"  # 2 epochs x 3 steps through the real driver
+    assert os.path.isdir(os.path.join(ckpt_dir, "6"))
+
+    restored = _run_pair(ckpt_dir, "v2", "restore")
+    assert restored[0] == restored[1], f"restore diverged: {restored}"
+    assert restored[0][0] == "6"
+    assert restored[0][2] == trained[0][2], (
+        f"restored digest {restored[0][2]} != trained digest {trained[0][2]}"
+    )
+
+
+@pytest.mark.slow
+def test_two_process_v3_train_agrees(tmp_path):
+    """v3 (asymmetric sharded aug pair, symmetric queue-free loss, AdamW +
+    warmup + momentum ramp) across a real process boundary."""
+    ckpt_dir = str(tmp_path / "ckpt_v3")
+    results = _run_pair(ckpt_dir, "v3", "train")
     assert results[0] == results[1], f"process state diverged: {results}"
-    # 3 steps of global batch 16 into a 64-slot queue
-    assert results[0][0] == "3"
-    assert results[0][3] == "48"
-    # collective checkpoint landed
-    assert os.path.isdir(os.path.join(ckpt_dir, "3"))
+    assert results[0][0] == "6"
