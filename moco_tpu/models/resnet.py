@@ -31,9 +31,49 @@ from functools import partial
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
+
+
+def _space_to_depth_stem(x, kernel, dtype):
+    """The 7x7/2 ImageNet stem conv computed as a space-to-depth 4x4/1 conv.
+
+    The MXU contracts over input channels in 128-lanes; a 3-channel conv
+    leaves it ~2% utilized. Re-tiling the image into 2x2 blocks
+    ([B,224,224,3] -> [B,112,112,12]) and zero-padding the kernel 7->8
+    ([7,7,3,64] -> [4,4,12,64]) computes the IDENTICAL convolution (same
+    products, regrouped) with 4x the contraction depth and no strided
+    window. The parameter stays the torchvision-shaped [7,7,3,64] — only
+    the trace-time compute is re-tiled, so checkpoints/exports are
+    unchanged. (MLPerf-era TPU ResNet trick; derivation in the test.)
+
+    Output position i reads x[2i+k-3], k=0..6. With the kernel left-padded
+    to 8 taps (k'=k+1) this is x[2i+k'-4]; writing k'=2q+p with p the
+    within-block offset gives blocks j=i+q-2, q=0..3 — a stride-1 4-tap
+    block conv with padding (2,1).
+    """
+    b, h, w, c = x.shape
+    kh, kw, cin, cout = kernel.shape  # [7,7,3,64]
+    kpad = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))  # [8,8,3,64]
+    k_s2d = (
+        kpad.reshape(4, 2, 4, 2, cin, cout)
+        .transpose(0, 2, 1, 3, 4, 5)
+        .reshape(4, 4, 4 * cin, cout)
+    )
+    x_s2d = (
+        x.reshape(b, h // 2, 2, w // 2, 2, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(b, h // 2, w // 2, 4 * c)
+    )
+    return jax.lax.conv_general_dilated(
+        x_s2d.astype(dtype),
+        k_s2d.astype(dtype),
+        window_strides=(1, 1),
+        padding=((2, 1), (2, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
 
 
 class BasicBlock(nn.Module):
@@ -110,14 +150,26 @@ class ResNet(nn.Module):
     dtype: Any = jnp.float32
     bn_momentum: float = 0.9
     bn_cross_replica_axis: str | None = None
+    s2d_stem: bool = True  # compute the 7x7/2 stem as a space-to-depth conv
+                           # (identical math, ~4x MXU contraction depth);
+                           # params/exports unchanged. Auto-skipped for odd
+                           # input sizes.
+    fast_bn: bool = True   # FastBatchNorm: Pallas streaming BN reductions on
+                           # TPU (identical flax math/params off-TPU)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(
             nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
         )
+        if self.fast_bn:
+            from moco_tpu.models.fast_bn import FastBatchNorm
+
+            norm_cls = FastBatchNorm
+        else:
+            norm_cls = nn.BatchNorm
         norm = partial(
-            nn.BatchNorm,
+            norm_cls,
             use_running_average=not train,
             momentum=self.bn_momentum,
             epsilon=1e-5,
@@ -131,6 +183,22 @@ class ResNet(nn.Module):
             x = conv(self.width, (3, 3), name="conv1")(x)
             x = norm(name="bn1")(x)
             x = nn.relu(x)
+        elif self.s2d_stem and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+            kernel = self.param(
+                "conv1",
+                # match nn.Conv's param tree: conv1/kernel with the default
+                # initializer, so checkpoints are interchangeable with the
+                # plain-conv stem
+                lambda rng: {
+                    "kernel": nn.initializers.lecun_normal()(
+                        rng, (7, 7, x.shape[-1], self.width), jnp.float32
+                    )
+                },
+            )["kernel"]
+            x = _space_to_depth_stem(x, kernel, self.dtype)
+            x = norm(name="bn1")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         else:
             x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv1")(x)
             x = norm(name="bn1")(x)

@@ -1,0 +1,171 @@
+"""FastBatchNorm (Pallas-stat BN) equivalence with `nn.BatchNorm`, and the
+streaming reduction kernels in interpret mode (SURVEY §2.10: the cuDNN
+fused-BN equivalent must be provably identical to the graph-level math)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.models.fast_bn import FastBatchNorm
+from moco_tpu.ops.pallas_stats import channel_grad_sums, channel_sums
+
+
+def _pair(dtype):
+    flax_bn = nn.BatchNorm(
+        use_running_average=False, momentum=0.9, epsilon=1e-5,
+        dtype=dtype, param_dtype=jnp.float32,
+    )
+    fast_bn = FastBatchNorm(
+        use_running_average=False, momentum=0.9, epsilon=1e-5,
+        dtype=dtype, param_dtype=jnp.float32,
+    )
+    return flax_bn, fast_bn
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fast_bn_train_matches_flax(dtype):
+    """Off-TPU the jnp path mirrors flax's op order exactly: forward output,
+    running-stat updates, and (via the custom VJP's closed form) gradients."""
+    flax_bn, fast_bn = _pair(dtype)
+    x = jax.random.normal(jax.random.key(0), (8, 6, 6, 16)) * 2.0 + 1.0
+    v1 = flax_bn.init(jax.random.key(1), x)
+    v2 = fast_bn.init(jax.random.key(1), x)
+    assert jax.tree.structure(v1) == jax.tree.structure(v2)
+    # shared weights so outputs are comparable
+    variables = {"params": v1["params"], "batch_stats": v1["batch_stats"]}
+
+    ya, muta = flax_bn.apply(variables, x, mutable=["batch_stats"])
+    yb, mutb = fast_bn.apply(variables, x, mutable=["batch_stats"])
+    # off-TPU the fast module IS flax's graph — bit-identical in both dtypes
+    np.testing.assert_array_equal(np.asarray(ya, np.float32), np.asarray(yb, np.float32))
+    for a, b in zip(
+        jax.tree.leaves(muta["batch_stats"]), jax.tree.leaves(mutb["batch_stats"]),
+        strict=True,
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    def loss(bn):
+        def f(params, x):
+            y, _ = bn.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                x, mutable=["batch_stats"],
+            )
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+        return f
+
+    ga, gxa = jax.grad(loss(flax_bn), argnums=(0, 1))(variables["params"], x)
+    gb, gxb = jax.grad(loss(fast_bn), argnums=(0, 1))(variables["params"], x)
+    # grads agree to ~1 ulp (autodiff reassociates one mul differently vs
+    # flax's in-place `mul *=` graph); the forward is bit-exact and the
+    # training-trajectory pin is test_golden.py, which must stay unchanged
+    np.testing.assert_allclose(
+        np.asarray(gxa, np.float32), np.asarray(gxb, np.float32),
+        rtol=3e-6, atol=5e-7,
+    )
+    for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb), strict=True):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-6, atol=5e-6)
+
+
+def test_fast_bn_eval_matches_flax():
+    flax_bn = nn.BatchNorm(use_running_average=True, epsilon=1e-5)
+    fast_bn = FastBatchNorm(use_running_average=True, epsilon=1e-5)
+    x = jax.random.normal(jax.random.key(2), (4, 5, 5, 8))
+    v = flax_bn.init(jax.random.key(3), x)
+    v["batch_stats"]["mean"] = jnp.linspace(-1, 1, 8)
+    v["batch_stats"]["var"] = jnp.linspace(0.5, 2, 8)
+    ya = flax_bn.apply(v, x)
+    yb = fast_bn.apply(v, x)
+    np.testing.assert_array_equal(np.asarray(ya), np.asarray(yb))
+
+
+def test_fast_bn_sync_axis(mesh8):
+    """SyncBN path: cross-device pmean statistics inside shard_map equal
+    global-batch statistics."""
+    from jax.sharding import PartitionSpec as P
+
+    bn = FastBatchNorm(use_running_average=False, axis_name="data")
+    x = jax.random.normal(jax.random.key(4), (16, 4, 4, 8))
+    v = bn.init(jax.random.key(5), x[:2])
+
+    def body(x):
+        y, mut = bn.apply(v, x, mutable=["batch_stats"])
+        return y, mut["batch_stats"]["mean"]
+
+    y, mean = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh8, in_specs=P("data"), out_specs=(P("data"), P()),
+        )
+    )(x)
+    xf = np.asarray(x, np.float64)
+    np.testing.assert_allclose(
+        np.asarray(mean), 0.1 * xf.mean(axis=(0, 1, 2)), rtol=1e-4, atol=1e-5
+    )  # running update: 0.9*0 + 0.1*batch_mean
+
+
+def test_channel_sums_interpret_matches_jnp():
+    x = jax.random.normal(jax.random.key(6), (1024, 24)).astype(jnp.bfloat16)
+    s, sq = channel_sums(x, interpret=True)
+    xf = np.asarray(x, np.float32)
+    np.testing.assert_allclose(np.asarray(s), xf.sum(0), rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(sq), (xf * xf).sum(0), rtol=1e-2, atol=1e-2)
+
+
+def test_channel_grad_sums_interpret_matches_jnp():
+    key = jax.random.key(7)
+    dy = jax.random.normal(key, (2048, 16)).astype(jnp.bfloat16)
+    x = jax.random.normal(jax.random.key(8), (2048, 16)).astype(jnp.bfloat16)
+    mean = jnp.linspace(-0.5, 0.5, 16)
+    rstd = jnp.linspace(0.8, 1.2, 16)
+    dsum, dxh = channel_grad_sums(dy, x, mean, rstd, interpret=True)
+    dyf = np.asarray(dy, np.float32)
+    xh = (np.asarray(x, np.float32) - np.asarray(mean)) * np.asarray(rstd)
+    np.testing.assert_allclose(np.asarray(dsum), dyf.sum(0), rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(dxh), (dyf * xh).sum(0), rtol=1e-2, atol=2e-2)
+
+
+def test_resnet_fast_bn_param_tree_unchanged():
+    """ResNet with fast_bn on/off has identical param + batch_stats trees —
+    checkpoints are interchangeable."""
+    from moco_tpu.models.resnet import BasicBlock, ResNet
+
+    kw = dict(stage_sizes=(1,), block_cls=BasicBlock, width=8,
+              num_classes=16, cifar_stem=True)
+    x = jnp.zeros((2, 16, 16, 3))
+    va = ResNet(fast_bn=False, **kw).init(jax.random.key(0), x, train=False)
+    vb = ResNet(fast_bn=True, **kw).init(jax.random.key(0), x, train=False)
+    assert jax.tree.structure(va) == jax.tree.structure(vb)
+    for a, b in zip(jax.tree.leaves(va), jax.tree.leaves(vb), strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_bn_train_custom_vjp_matches_autodiff(dtype):
+    """The TPU path's custom VJP (closed-form dx, Pallas-shaped reductions —
+    jnp fallback internals here) agrees with flax autodiff to float
+    tolerance. On TPU this same code runs with the Pallas kernels."""
+    from moco_tpu.models.fast_bn import _bn_train
+
+    flax_bn = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                           epsilon=1e-5, dtype=dtype, param_dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(10), (8, 6, 6, 16)) * 1.7
+    v = flax_bn.init(jax.random.key(11), x)
+    scale, bias = v["params"]["scale"], v["params"]["bias"]
+
+    def loss_custom(x, scale, bias):
+        y, _, _ = _bn_train(x, scale, bias, 1e-5, dtype)
+        return jnp.sum(jnp.sin(y.astype(jnp.float32)))
+
+    def loss_flax(x, params):
+        y, _ = flax_bn.apply(
+            {"params": params, "batch_stats": v["batch_stats"]},
+            x, mutable=["batch_stats"])
+        return jnp.sum(jnp.sin(y.astype(jnp.float32)))
+
+    gx, gs, gb = jax.grad(loss_custom, argnums=(0, 1, 2))(x, scale, bias)
+    gxa, ga = jax.grad(loss_flax, argnums=(0, 1))(x, v["params"])
+    tol = dict(rtol=1e-4, atol=1e-5) if dtype == jnp.float32 else dict(rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(np.asarray(gx, np.float32), np.asarray(gxa, np.float32), **tol)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(ga["scale"]), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(ga["bias"]), rtol=1e-3, atol=1e-3)

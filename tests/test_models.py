@@ -107,3 +107,48 @@ def test_v3_heads_shapes():
     qv = pred.init(jax.random.key(0), jnp.zeros((2, 256)), train=False)
     out2 = pred.apply(qv, out, train=False)
     assert out2.shape == (2, 256)
+
+
+def test_s2d_stem_equals_plain_conv_stem():
+    """The space-to-depth stem computes the SAME convolution as the plain
+    7x7/2 conv (products regrouped only): same param tree, matching outputs,
+    matching gradients — so checkpoints and training dynamics are unchanged
+    while the MXU contracts over 12 channels instead of 3."""
+    from moco_tpu.models.resnet import BasicBlock, ResNet
+
+    kw = dict(stage_sizes=(1,), block_cls=BasicBlock, width=8, num_classes=16)
+    plain = ResNet(s2d_stem=False, **kw)
+    s2d = ResNet(s2d_stem=True, **kw)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    v = plain.init(jax.random.key(1), x, train=False)
+    # identical param trees (s2d re-tiles at trace time, not in the params)
+    v2 = s2d.init(jax.random.key(1), x, train=False)
+    assert jax.tree.structure(v) == jax.tree.structure(v2)
+    assert v["params"]["conv1"]["kernel"].shape == (7, 7, 3, 8)
+
+    out_a = plain.apply(v, x, train=False)
+    out_b = s2d.apply(v, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(params, model):
+        return jnp.sum(model.apply({"params": params,
+                                    "batch_stats": v["batch_stats"]},
+                                   x, train=False) ** 2)
+
+    ga = jax.grad(loss)(v["params"], plain)
+    gb = jax.grad(loss)(v["params"], s2d)
+    for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb), strict=True):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_s2d_stem_falls_back_on_odd_sizes():
+    from moco_tpu.models.resnet import BasicBlock, ResNet
+
+    model = ResNet(stage_sizes=(1,), block_cls=BasicBlock, width=8,
+                   num_classes=16, s2d_stem=True)
+    x = jnp.zeros((2, 33, 33, 3))
+    v = model.init(jax.random.key(0), x, train=False)
+    out = model.apply(v, x, train=False)
+    assert out.shape == (2, 16)
