@@ -1,0 +1,21 @@
+"""CPU-scale learning-dynamics run (config-1 shape at micro scale): evidence
+for hardening test_smoke_train thresholds. Writes runs/horizon_cpu_r2.log."""
+import json, os, time
+from moco_tpu.parallel.mesh import force_cpu_devices
+force_cpu_devices(8)
+import jax
+from moco_tpu.config import get_preset
+from moco_tpu.train import train
+
+cfg = get_preset("cifar10-moco-v1").replace(
+    arch="resnet_tiny", cifar_stem=True, dataset="synthetic", image_size=16,
+    batch_size=64, num_negatives=512, embed_dim=32, lr=0.12, cos=True,
+    epochs=24, steps_per_epoch=64,   # 1536 steps
+    knn_monitor=True, knn_bank_size=1024, num_classes=10,
+    ckpt_dir="", tb_dir="", print_freq=9999, num_workers=1,
+)
+t0 = time.time()
+state, metrics = train(cfg)
+print(json.dumps({"final_knn_top1": metrics.get("knn_top1"),
+                  "final_loss": metrics.get("loss"),
+                  "steps": int(state.step), "wall_s": round(time.time()-t0,1)}))

@@ -97,6 +97,8 @@ class EvalConfig:
     # kNN protocol (SURVEY §2.5): top-200 neighbors, T=0.07
     knn_k: int = 200
     knn_temperature: float = 0.07
+    knn_bank_chunk: int = 65536       # bank rows per streamed top-k slice
+                                      # (caps sims at [batch, chunk]; 0 = off)
     print_freq: int = 10
     ckpt_dir: str = "lincls_checkpoints"  # probe checkpoints ("" = off)
     resume: str = ""                      # "" | "auto" (latest probe ckpt)

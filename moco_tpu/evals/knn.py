@@ -100,6 +100,7 @@ def run_knn(config: EvalConfig, mesh=None) -> float:
         num_classes=config.num_classes,
         k=config.knn_k,
         temperature=config.knn_temperature,
+        bank_chunk=config.knn_bank_chunk or None,
     )
     print(f"kNN top-1: {100 * acc:.2f}% (k={config.knn_k}, T={config.knn_temperature})")
     return acc

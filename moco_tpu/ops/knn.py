@@ -51,8 +51,11 @@ def _knn_predict_prenormalized(
         pad = n_chunks * bank_chunk - n
         bank = jnp.pad(bank, ((0, pad), (0, 0)))
         # padded rows have sim 0 to everything; push them below any real
-        # neighbor with a -inf sentinel so they never out-rank real rows
-        valid = jnp.pad(jnp.ones((n,), jnp.float32), (0, pad),
+        # neighbor with an ADDITIVE -inf mask (0 on valid rows) so real
+        # similarities pass through bit-exact — a min/clamp sentinel would
+        # flatten sims that exceed it (normalized features can give
+        # 1+ulp sims) into artificial ties with path-dependent winners
+        valid = jnp.pad(jnp.zeros((n,), jnp.float32), (0, pad),
                         constant_values=-jnp.inf)
         bank_labels = jnp.pad(bank_labels, (0, pad))
         chunks = bank.reshape(n_chunks, bank_chunk, -1)
@@ -64,7 +67,7 @@ def _knn_predict_prenormalized(
             cb, cl, cv = chunk
             sims = jnp.einsum("bc,nc->bn", feats, cb,
                               preferred_element_type=jnp.float32)
-            sims = jnp.minimum(sims, cv[None, :])   # -inf on padded rows
+            sims = sims + cv[None, :]               # -inf on padded rows
             top_s, top_i = lax.top_k(sims, k)
             cand_s = jnp.concatenate([best_s, top_s], axis=1)       # [B, 2k]
             cand_l = jnp.concatenate([best_l, cl[top_i]], axis=1)

@@ -43,8 +43,11 @@ def test_moco_v1_smoke_loss_falls_knn_above_chance(trained):
     config, state, metrics, export, tmp_path = trained
     assert int(state.step) == 48
     assert np.isfinite(metrics["loss"])
-    # 10-class synthetic data: chance = 10%; the features must beat it well
-    assert metrics["knn_top1"] > 0.2, f"kNN top-1 {metrics['knn_top1']} not above chance"
+    # 10-class synthetic data, chance = 10%. Healthy runs measure kNN
+    # 0.95-0.99 here across seeds (runs/README.md; 3-seed r2 measurement),
+    # so 0.9 catches subtle algorithmic regressions (aug order, EMA rate)
+    # that the old above-chance bar (0.2) would have passed
+    assert metrics["knn_top1"] > 0.9, f"kNN top-1 {metrics['knn_top1']} below healthy range"
     assert os.path.exists(export)
     try:
         import tensorboardX  # noqa: F401  (optional dep; writer no-ops without it)
@@ -68,7 +71,8 @@ def test_lincls_on_trained_export(trained, mesh8):
         epochs=1, lr=1.0, print_freq=8, ckpt_dir="",
     )
     fc, best_acc1 = train_lincls(eval_cfg, mesh8, max_steps=24)
-    assert best_acc1 > 30.0, f"probe on pretrained features only {best_acc1}%"
+    # healthy runs measure ~66% after 24 probe steps (runs/README.md)
+    assert best_acc1 > 50.0, f"probe on pretrained features only {best_acc1}%"
 
 
 @pytest.mark.slow
@@ -81,4 +85,5 @@ def test_knn_on_trained_export(trained):
         image_size=16, cifar_stem=True, num_classes=10, knn_k=20, ckpt_dir="",
     )
     acc = run_knn(eval_cfg)
-    assert acc > 0.5, f"kNN on pretrained features only {acc}"
+    # healthy runs measure 100% here (runs/README.md)
+    assert acc > 0.9, f"kNN on pretrained features only {acc}"
