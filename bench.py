@@ -16,15 +16,101 @@ Extra modes (VERDICT r1: the input path must be measured, not amortized away):
   --mode e2e     the timed train loop fed by epoch_loader + ImageFolder over
                  a generated JPEG tree (honest host-decode-in-the-loop
                  number) — one JSON line, imgs/sec/chip.
+
+Resilience (VERDICT r2 #1 — BENCH_r02 died rc=1 on a transient backend
+`UNAVAILABLE` with no retry): the default entry point is an ORCHESTRATOR that
+never touches a JAX backend itself. It runs the measurement in a child
+process (`--child`), retries the TPU attempt with backoff on failure OR
+hang (the axon relay has been observed to both raise UNAVAILABLE and hang
+in device init), then degrades to the CPU-proxy metric, and as a last
+resort emits a JSON line with an "error" field — it always prints one JSON
+line and exits 0.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+
+# per-mode metric/unit for the last-resort error record, matching the names
+# the success path would have emitted so consumers can pair them
+BENCH_FALLBACK_METRICS = {
+    "step": ("moco_v2_r50_pretrain_throughput_per_chip", "imgs/sec/chip"),
+    "input": ("host_staging_throughput", "imgs/sec"),
+    "e2e": ("moco_v2_r50_e2e_input_fed_throughput_per_chip", "imgs/sec/chip"),
+}
+
+
+def _run_child(mode: str, timeout_s: float, env_extra: dict | None = None):
+    """Run `bench.py --child --mode <mode>` in a fresh process; return the
+    last JSON-parsable stdout line, or an error string."""
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", "--mode", mode],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s:.0f}s"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed, None
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+    return None, f"rc={proc.returncode}: " + " | ".join(tail)[-500:]
+
+
+def orchestrate(mode: str) -> None:
+    """Retry-with-backoff TPU measurement → CPU-proxy degradation → JSON
+    error record. Never raises, never exits non-zero, always prints exactly
+    one JSON line to stdout."""
+    errors = []
+    # input mode never needs an accelerator: run it on the CPU backend only
+    attempts = (
+        [("cpu", {"JAX_PLATFORMS": "cpu"}, 1200.0)]
+        if mode == "input"
+        else [
+            ("tpu", {}, 1500.0),     # first compile on the relay is slow
+            # retry with the newest Pallas path disabled, in case a Mosaic
+            # compile failure (not a backend outage) killed attempt 1
+            ("tpu-retry", {"MOCO_TPU_DISABLE_FUSED": "1"}, 900.0),
+            ("cpu-proxy", {"JAX_PLATFORMS": "cpu"}, 1200.0),
+        ]
+    )
+    for name, env_extra, timeout_s in attempts:
+        result, err = _run_child(mode, timeout_s, env_extra)
+        if result is not None:
+            if errors:
+                result["degraded_from"] = errors
+            print(json.dumps(result), flush=True)
+            return
+        errors.append(f"{name}: {err}")
+        time.sleep(20.0 if name == "tpu" else 2.0)
+    metric, unit = BENCH_FALLBACK_METRICS[mode]
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": 0.0,
+                "unit": unit,
+                "vs_baseline": 0.0,
+                "error": "; ".join(errors)[-900:],
+            }
+        ),
+        flush=True,
+    )
+
+
 import numpy as np
 
 BASELINE_IMGS_PER_SEC_PER_CHIP = 168.0  # 8xV100 MoCo-v2, BASELINE.md
@@ -59,7 +145,6 @@ def _make_jpeg_tree(root, n_images: int = 256, classes: int = 4, size=(500, 375)
 
 def bench_input():
     """Host staging throughput: native loader by thread count + PIL."""
-    import os
     import tempfile
 
     from moco_tpu.data.datasets import ImageFolder
@@ -116,6 +201,8 @@ def bench_e2e():
     metric is exactly the un-overlapped host input cost on this host."""
     import tempfile
 
+    import jax
+
     from moco_tpu.config import get_preset
     from moco_tpu.data.augment import build_two_crops_sharded, v2_aug_config
     from moco_tpu.data.datasets import ImageFolder
@@ -133,6 +220,8 @@ def bench_e2e():
     _make_jpeg_tree(root, n_images=batch * 4)
     if on_tpu:
         config = get_preset("imagenet-moco-v2").replace(batch_size=batch)
+        if os.environ.get("MOCO_TPU_DISABLE_FUSED"):
+            config = config.replace(fused_bn_conv=False)
         steps = 6
     else:
         config = get_preset("imagenet-moco-v2").replace(
@@ -193,6 +282,9 @@ def bench_e2e():
 
 
 def main():
+    import jax
+    import jax.numpy as jnp
+
     from moco_tpu.config import get_preset
     from moco_tpu.data.augment import build_two_crops_sharded, v2_aug_config
     from moco_tpu.parallel.mesh import create_mesh
@@ -210,6 +302,10 @@ def main():
             batch_size=128 * n_chips, dataset="synthetic"
         )
         steps, warmup = 20, 10
+        if os.environ.get("MOCO_TPU_DISABLE_FUSED"):
+            # orchestrator retry path: rule out the fused Pallas tail as the
+            # failure cause
+            config = config.replace(fused_bn_conv=False)
     else:  # CPU fallback so the bench is runnable anywhere (tiny proxy)
         config = get_preset("imagenet-moco-v2").replace(
             arch="resnet_tiny", cifar_stem=True, compute_dtype="float32",
@@ -291,8 +387,15 @@ if __name__ == "__main__":
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--mode", choices=["step", "input", "e2e"], default="step")
+    parser.add_argument(
+        "--child", action="store_true",
+        help="run the measurement in THIS process (no retry shell); the "
+             "default entry orchestrates children with retry + degradation",
+    )
     args = parser.parse_args()
-    if args.mode == "input":
+    if not args.child:
+        orchestrate(args.mode)
+    elif args.mode == "input":
         bench_input()
     elif args.mode == "e2e":
         bench_e2e()

@@ -369,9 +369,31 @@ def timm_to_vit(
     timm-dialect checkpoint (ours, or any timm ViT with fused qkv).
     `num_heads` splits the fused qkv back into flax's [D, H, hd] kernels —
     12 for every moco-v3 arch (its `vits.py` uses head dim 32 throughout).
-    `pos_embed`/`head.*` entries are ignored (fixed sin-cos buffer / probe
-    head, not backbone params)."""
+    `head.*` entries are ignored (probe head, not backbone params). A
+    `pos_embed` entry is CHECKED against our fixed sin-cos buffer: the flax
+    ViT has no positional parameter, so a checkpoint with a LEARNED pos_embed
+    would silently run with different positions — that import is refused
+    rather than degraded (ADVICE r2)."""
     width = int(flat[f"{prefix}cls_token"].shape[-1])
+    pe = flat.get(f"{prefix}pos_embed")
+    if pe is not None:
+        pe = np.asarray(pe)
+        n_patches = pe.shape[-2] - 1
+        g = int(round(n_patches ** 0.5))
+        expected = (
+            _sincos_pos_embed_np(g, g, width)
+            if g * g == n_patches
+            else None
+        )
+        if expected is None or not np.allclose(
+            pe.reshape(expected.shape), expected, rtol=1e-3, atol=1e-3
+        ):
+            raise ValueError(
+                "timm checkpoint carries a pos_embed that differs from the "
+                "fixed 2-D sin-cos buffer this ViT uses (a learned or resized "
+                "positional embedding). Importing it would silently change "
+                "token positions; convert the checkpoint (or retrain) instead."
+            )
     hd = width // num_heads
     tree: dict = {
         "cls_token": np.asarray(flat[f"{prefix}cls_token"]),

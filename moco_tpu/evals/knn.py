@@ -88,7 +88,10 @@ def run_knn(config: EvalConfig, mesh=None) -> float:
     if mesh is None:
         mesh = create_mesh()
     model, params, stats = load_frozen_backbone(config)
-    train_set = build_dataset(config.dataset, config.data_dir, image_size=config.image_size)
+    train_set = build_dataset(
+        config.dataset, config.data_dir, image_size=config.image_size,
+        stage_size=config.stage_size, num_workers=config.num_workers,
+    )
     val_set = _val_split(config)
     bank, bank_labels = encode_dataset(model, params, stats, train_set, config, mesh=mesh)
     queries, qlabels = encode_dataset(model, params, stats, val_set, config, mesh=mesh)

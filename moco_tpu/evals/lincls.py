@@ -195,7 +195,10 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
         mesh = create_mesh()
     local_batch_size(config.batch_size, mesh)  # divisibility check
 
-    train_set = build_dataset(config.dataset, config.data_dir, image_size=config.image_size)
+    train_set = build_dataset(
+        config.dataset, config.data_dir, image_size=config.image_size,
+        stage_size=config.stage_size, num_workers=config.num_workers,
+    )
     val_set = _val_split(config)
     model, backbone_params, backbone_stats = load_frozen_backbone(config)
     # pin the frozen backbone REPLICATED across the mesh once — otherwise the
@@ -214,11 +217,13 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
 
     steps_per_epoch = max(len(train_set) // config.batch_size, 1)
 
+    lr = config.effective_lr  # resolves base_lr × batch/256 presets (v3 probe)
+
     def sched(step):
         epoch = jnp.floor(step / steps_per_epoch)
         if config.cos:
-            return cosine_lr(config.lr, epoch, config.epochs)
-        return step_lr(config.lr, epoch, config.schedule)
+            return cosine_lr(lr, epoch, config.epochs)
+        return step_lr(lr, epoch, config.schedule)
 
     tx = optax.chain(
         optax.add_decayed_weights(config.weight_decay),
@@ -326,6 +331,7 @@ def _val_split(config: EvalConfig):
         return build_dataset(
             "imagefolder", os.path.join(config.data_dir, "val"),
             image_size=config.image_size,
+            stage_size=config.stage_size, num_workers=config.num_workers,
         )
     if config.dataset == "cifar10":
         from moco_tpu.data.datasets import CIFAR10
@@ -337,9 +343,13 @@ def _val_split(config: EvalConfig):
 
 
 def main(argv=None):
-    from moco_tpu.config import add_config_flags, collect_overrides
+    from moco_tpu.config import PRESETS, add_config_flags, collect_overrides, get_preset
 
     parser = argparse.ArgumentParser(description="moco_tpu linear probe")
+    eval_presets = sorted(
+        n for n, c in PRESETS.items() if isinstance(c, EvalConfig)
+    )
+    parser.add_argument("--preset", default="imagenet-lincls", choices=eval_presets)
     add_config_flags(parser, EvalConfig)
     parser.add_argument("--max-steps", type=int, default=None)
     parser.add_argument("--fake-devices", type=int, default=0)
@@ -348,7 +358,7 @@ def main(argv=None):
         from moco_tpu.parallel.mesh import force_cpu_devices
 
         force_cpu_devices(args.fake_devices)
-    config = EvalConfig().replace(**collect_overrides(args, EvalConfig))
+    config = get_preset(args.preset).replace(**collect_overrides(args, EvalConfig))
     print(f"config: {config}")
     _, best = train_lincls(config, max_steps=args.max_steps)
     print(f"best val Acc@1: {best:.2f}")

@@ -105,9 +105,10 @@ _bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
 class FastBatchNorm(nn.Module):
     """Drop-in `nn.BatchNorm` (same fields, params, and `batch_stats`
     collection) with Pallas train-mode statistics on TPU. `axis_name`
-    (SyncBN) delegates to `nn.BatchNorm` — cross-device stats need a psum
-    inside the stat computation (transfer configs only; param names kept
-    identical by reusing this module's scope)."""
+    (SyncBN) takes the inline jnp path with a `pmean` over the per-device
+    mean/mean² (mathematically the cross-device batch stats; flax's exact op
+    order, autodiff backward) — the Pallas custom-VJP path is per-device
+    only, so sync mode never uses it."""
 
     use_running_average: bool = False
     momentum: float = 0.9

@@ -1,0 +1,177 @@
+"""Fused bn→relu→1x1-conv tail for the Bottleneck block (custom VJP).
+
+The Bottleneck's `bn2 → relu → conv3` sequence materializes the normalized
+activation in HBM twice (write after normalize, read by the conv). On the
+HBM-bound MoCo step that's pure waste: a 1x1 conv is a matmul, and the
+normalize+ReLU is an affine-plus-clamp that can run in-register while tiles
+stream into the MXU (`ops/pallas_fused_conv.py`). This module packages that
+kernel with
+
+- parameter/variable declaration that EXACTLY mirrors the unfused modules
+  (`bn2/{scale,bias}`, `batch_stats bn2/{mean,var}`, `conv3/kernel` of shape
+  [1,1,K,N]) so checkpoints/exports are byte-compatible either way, and
+- a custom VJP whose backward recomputes z = relu(x̂) inside the dW matmul
+  operand (one extra streaming read of x instead of a stored z) and reuses
+  FastBatchNorm's closed-form BN chain (`pallas_stats` reductions on TPU).
+
+Off-TPU the SAME params drive a plain `lax.conv`-based path (flax op order),
+so golden tests and CPU training are unchanged; the Pallas path engages on
+TPU only. SyncBN (`axis_name`) is not supported here — the caller falls back
+to the unfused modules (MoCo's BN is per-device by design, SURVEY §7).
+
+Reference equivalent: cuDNN fused conv+BN epilogues (SURVEY §2.10).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from moco_tpu.models.fast_bn import _batch_stats, _normalize, _use_pallas
+from moco_tpu.ops.pallas_fused_conv import bn_relu_matmul
+from moco_tpu.ops.pallas_stats import channel_grad_sums
+
+
+def _plain_apply(x, mean, var, scale, bias, w4d, eps, dtype):
+    """The unfused math in flax's exact op order: f32 normalize cast to
+    `dtype`, ReLU, then the 1x1 conv as `lax.conv` in `dtype` (what
+    `nn.Conv(use_bias=False, dtype=...)` lowers to)."""
+    z = nn.relu(_normalize(x, mean, var, scale, bias, eps, dtype))
+    return jax.lax.conv_general_dilated(
+        z,
+        w4d.astype(dtype),
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _train_impl(x, scale, bias, w4d, eps, dtype):
+    mean, var = _batch_stats(x, _use_pallas())
+    if _use_pallas():
+        k, n = w4d.shape[-2], w4d.shape[-1]
+        rstd = jax.lax.rsqrt(var + eps)
+        a = scale * rstd
+        y = bn_relu_matmul(
+            x.reshape(-1, k),
+            a,
+            bias - mean * a,
+            w4d.reshape(k, n).astype(dtype),
+            out_dtype=dtype,
+        ).reshape(*x.shape[:-1], n)
+    else:
+        y = _plain_apply(x, mean, var, scale, bias, w4d, eps, dtype)
+    return y, mean, var
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _bn_relu_conv_train(x, scale, bias, w4d, eps, dtype):
+    return _train_impl(x, scale, bias, w4d, eps, dtype)
+
+
+def _fwd(x, scale, bias, w4d, eps, dtype):
+    y, mean, var = _train_impl(x, scale, bias, w4d, eps, dtype)
+    return (y, mean, var), (x, mean, var, scale, bias, w4d)
+
+
+def _bwd(eps, dtype, res, cts):
+    x, mean, var, scale, bias, w4d = res
+    dy, _dmean, _dvar = cts  # stats feed the (non-differentiated) running
+    #                          stats; their cotangents are zero
+    k, n = w4d.shape[-2], w4d.shape[-1]
+    m_rows = x.size // k
+    xr = x.reshape(m_rows, k)
+    dyr = dy.reshape(m_rows, n)
+    rstd = jax.lax.rsqrt(var + eps)  # f32
+    a = (scale * rstd).astype(jnp.float32)
+    shift = (bias - mean * a).astype(jnp.float32)
+    # recompute ẑ in the dW operand (streams x once; never stored)
+    zpre = xr.astype(jnp.float32) * a + shift
+    z = jnp.maximum(zpre, 0.0).astype(dtype)
+    dw = jnp.einsum(
+        "mk,mn->kn", z, dyr, preferred_element_type=jnp.float32
+    ).reshape(w4d.shape).astype(w4d.dtype)
+    # gradient at the normalize output, ReLU-masked
+    g = jnp.einsum(
+        "mn,kn->mk", dyr, w4d.reshape(k, n).astype(dyr.dtype),
+        preferred_element_type=jnp.float32,
+    ) * (zpre > 0)
+    g = g.reshape(x.shape)
+    # BN chain (FastBatchNorm's closed form): dγ = Σg·x̂, dβ = Σg,
+    # dx = γ·r·(g − (x̂·Σ(g·x̂) + Σg)/N)
+    if _use_pallas():
+        dsum, dxh = channel_grad_sums(g, x, mean, rstd)
+    else:
+        gf = g.reshape(m_rows, k)
+        xh = (xr.astype(jnp.float32) - mean) * rstd
+        dsum = jnp.sum(gf, axis=0)
+        dxh = jnp.sum(gf * xh, axis=0)
+    nelem = m_rows
+    xh_full = (x.astype(jnp.float32) - mean) * rstd
+    dx = (scale * rstd) * (
+        g.astype(jnp.float32) - (xh_full * (dxh / nelem) + dsum / nelem)
+    )
+    return (
+        dx.astype(x.dtype),
+        dxh.astype(scale.dtype),
+        dsum.astype(bias.dtype),
+        dw,
+    )
+
+
+_bn_relu_conv_train.defvjp(_fwd, _bwd)
+
+
+def fused_bn_relu_conv3(
+    mdl: nn.Module,
+    x: jax.Array,
+    features: int,
+    train: bool,
+    momentum: float,
+    eps: float,
+    dtype,
+) -> jax.Array:
+    """Declare bn2+conv3 params/stats under `mdl`'s scope (names identical
+    to the unfused `nn.BatchNorm(name="bn2")` + `nn.Conv(name="conv3")`) and
+    apply the fused tail."""
+    k = x.shape[-1]
+    bn = mdl.param(
+        "bn2",
+        lambda rng: {
+            "scale": jnp.ones((k,), jnp.float32),
+            "bias": jnp.zeros((k,), jnp.float32),
+        },
+    )
+    w4d = mdl.param(
+        "conv3",
+        lambda rng: {
+            "kernel": nn.initializers.lecun_normal()(
+                rng, (1, 1, k, features), jnp.float32
+            )
+        },
+    )["kernel"]
+    ra = mdl.variable(
+        "batch_stats",
+        "bn2",
+        lambda: {
+            "mean": jnp.zeros((k,), jnp.float32),
+            "var": jnp.ones((k,), jnp.float32),
+        },
+    )
+    if not train or mdl.is_initializing():
+        y = _plain_apply(
+            x, ra.value["mean"], ra.value["var"], bn["scale"], bn["bias"],
+            w4d, eps, dtype,
+        )
+        return y
+    y, mean, var = _bn_relu_conv_train(
+        x, bn["scale"], bn["bias"], w4d, eps, dtype
+    )
+    ra.value = {
+        "mean": momentum * ra.value["mean"] + (1 - momentum) * mean,
+        "var": momentum * ra.value["var"] + (1 - momentum) * var,
+    }
+    return y

@@ -101,13 +101,21 @@ class BasicBlock(nn.Module):
 
 
 class Bottleneck(nn.Module):
-    """1x1 → 3x3(stride) → 1x1(x4) residual block (ResNet-50/101/152, v1.5)."""
+    """1x1 → 3x3(stride) → 1x1(x4) residual block (ResNet-50/101/152, v1.5).
+
+    `fused_tail=True` computes the bn2→relu→conv3 tail through the Pallas
+    fused kernel (models/fused_block.py): identical params/names/math, the
+    normalized activation never materializes in HBM. Engages the kernel on
+    TPU only; incompatible with SyncBN (callers gate on that)."""
 
     filters: int
     strides: int = 1
     conv: ModuleDef = nn.Conv
     norm: ModuleDef = nn.BatchNorm
     expansion: int = 4
+    fused_tail: bool = False
+    bn_momentum: float = 0.9
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
@@ -116,9 +124,21 @@ class Bottleneck(nn.Module):
         y = self.norm(name="bn1")(y)
         y = nn.relu(y)
         y = self.conv(self.filters, (3, 3), (self.strides, self.strides), name="conv2")(y)
-        y = self.norm(name="bn2")(y)
-        y = nn.relu(y)
-        y = self.conv(self.filters * self.expansion, (1, 1), name="conv3")(y)
+        if self.fused_tail:
+            from moco_tpu.models.fused_block import fused_bn_relu_conv3
+
+            # train flag: the norm partial carries use_running_average=not train
+            train = not getattr(self.norm, "keywords", {}).get(
+                "use_running_average", False
+            )
+            y = fused_bn_relu_conv3(
+                self, y, self.filters * self.expansion, train,
+                self.bn_momentum, 1e-5, self.dtype,
+            )
+        else:
+            y = self.norm(name="bn2")(y)
+            y = nn.relu(y)
+            y = self.conv(self.filters * self.expansion, (1, 1), name="conv3")(y)
         y = self.norm(name="bn3")(y)
         if residual.shape != y.shape:
             residual = self.conv(
@@ -156,6 +176,14 @@ class ResNet(nn.Module):
                            # input sizes.
     fast_bn: bool = True   # FastBatchNorm: Pallas streaming BN reductions on
                            # TPU (identical flax math/params off-TPU)
+    remat: bool = False    # per-residual-block rematerialization: save only
+                           # block boundaries, recompute internals in the
+                           # backward — trades (underutilized) MXU FLOPs for
+                           # HBM traffic on the memory-bound step. Identical
+                           # numerics (same ops, re-executed).
+    fused_bn_conv: bool = False  # Bottleneck bn2→relu→conv3 via the Pallas
+                                 # fused kernel (same params; TPU-only
+                                 # engagement; ignored for BasicBlock/SyncBN)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -205,15 +233,30 @@ class ResNet(nn.Module):
             x = nn.relu(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
 
+        block_kwargs = {}
+        if (
+            self.fused_bn_conv
+            and self.block_cls is Bottleneck
+            and self.bn_cross_replica_axis is None
+            # engage on TPU only: the CPU fallback inside the fused tail is
+            # mathematically equal but uses the closed-form BN backward,
+            # while off-TPU goldens pin flax-autodiff numerics bit-exactly
+            and jax.default_backend() == "tpu"
+        ):
+            block_kwargs = dict(
+                fused_tail=True, bn_momentum=self.bn_momentum, dtype=self.dtype
+            )
+        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
         for i, num_blocks in enumerate(self.stage_sizes):
             for j in range(num_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block_cls(
+                x = block_cls(
                     filters=self.width * 2**i,
                     strides=strides,
                     conv=conv,
                     norm=norm,
                     name=f"layer{i + 1}_{j}",
+                    **block_kwargs,
                 )(x)
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool → [B, feat_dim]

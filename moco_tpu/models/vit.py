@@ -129,9 +129,11 @@ class ViT(nn.Module):
 # attention architecture (ADVICE r1).
 ViT_Small = partial(ViT, width=384, depth=12, num_heads=12)
 ViT_Base = partial(ViT, width=768, depth=12, num_heads=12)
+# test/debug arch (keeps moco-v3's 32-per-head convention at width 64)
+ViT_Tiny = partial(ViT, width=64, depth=2, num_heads=2)
 
-VIT_ARCHS = {"vit_small": ViT_Small, "vit_base": ViT_Base}
-VIT_FEATURE_DIMS = {"vit_small": 384, "vit_base": 768}
+VIT_ARCHS = {"vit_tiny": ViT_Tiny, "vit_small": ViT_Small, "vit_base": ViT_Base}
+VIT_FEATURE_DIMS = {"vit_tiny": 64, "vit_small": 384, "vit_base": 768}
 
 
 def build_vit(arch: str, num_classes: int | None = None, **kwargs) -> ViT:

@@ -45,7 +45,12 @@ def _knn_predict_prenormalized(
         top_sims, top_idx = lax.top_k(sims, k)                  # [B, k]
         neigh_labels = bank_labels[top_idx]                     # [B, k]
     else:
-        k = min(k, bank_chunk)
+        # exact for ANY k ≤ N (ADVICE r2: k used to be silently clamped to
+        # bank_chunk): each chunk can contribute at most min(k, bank_chunk)
+        # rows to the global top-k, so a carry of k rows merged with
+        # per-chunk top-min(k, chunk) loses nothing
+        k = min(k, n)
+        chunk_k = min(k, bank_chunk)
         b = feats.shape[0]
         n_chunks = -(-n // bank_chunk)
         pad = n_chunks * bank_chunk - n
@@ -68,8 +73,8 @@ def _knn_predict_prenormalized(
             sims = jnp.einsum("bc,nc->bn", feats, cb,
                               preferred_element_type=jnp.float32)
             sims = sims + cv[None, :]               # -inf on padded rows
-            top_s, top_i = lax.top_k(sims, k)
-            cand_s = jnp.concatenate([best_s, top_s], axis=1)       # [B, 2k]
+            top_s, top_i = lax.top_k(sims, chunk_k)
+            cand_s = jnp.concatenate([best_s, top_s], axis=1)       # [B, k+chunk_k]
             cand_l = jnp.concatenate([best_l, cl[top_i]], axis=1)
             best_s, sel = lax.top_k(cand_s, k)
             best_l = jnp.take_along_axis(cand_l, sel, axis=1)

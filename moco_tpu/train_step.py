@@ -70,6 +70,8 @@ def build_encoder(config: PretrainConfig):
                 cifar_stem=config.cifar_stem,
                 dtype=dtype,
                 bn_cross_replica_axis=DATA_AXIS if config.sync_bn else None,
+                remat=config.remat,
+                fused_bn_conv=config.fused_bn_conv,
             )
         return V3Model(backbone, embed_dim=config.embed_dim)
     if config.arch.startswith("vit"):
@@ -85,6 +87,8 @@ def build_encoder(config: PretrainConfig):
         cifar_stem=config.cifar_stem,
         dtype=dtype,
         bn_cross_replica_axis=DATA_AXIS if config.sync_bn else None,
+        remat=config.remat,
+        fused_bn_conv=config.fused_bn_conv,
     )
 
 
@@ -96,15 +100,17 @@ def lr_schedule(config: PretrainConfig, steps_per_epoch: int) -> Callable:
     stepping the whole first warmup epoch would run at lr=0."""
     from moco_tpu.ops.schedules import cosine_lr, step_lr, warmup_cosine_lr
 
+    lr = config.effective_lr  # resolves base_lr × batch/256 presets
+
     def sched(step):
         epoch = jnp.asarray(step, jnp.float32) / steps_per_epoch
         if config.variant != "v3":
             epoch = jnp.floor(epoch)
         if config.warmup_epochs > 0:
-            return warmup_cosine_lr(config.lr, epoch, config.epochs, config.warmup_epochs)
+            return warmup_cosine_lr(lr, epoch, config.epochs, config.warmup_epochs)
         if config.cos:
-            return cosine_lr(config.lr, epoch, config.epochs)
-        return step_lr(config.lr, epoch, config.schedule)
+            return cosine_lr(lr, epoch, config.epochs)
+        return step_lr(lr, epoch, config.schedule)
 
     return sched
 

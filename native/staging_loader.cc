@@ -118,37 +118,54 @@ void stage_rect(const uint8_t* src, int w, int h, int H, int W, uint8_t* dst,
     src = tbuf.data();
     rot = 1;
   }
-  const float scale =
-      std::min(static_cast<float>(H) / h, static_cast<float>(W) / w);
+  // Fit-DOWNSCALE only (scale capped at 1): an image that already fits the
+  // canvas is staged at its ORIGINAL resolution — upsampling would burn
+  // canvas bandwidth without adding information, and full-resolution staging
+  // is the point (the on-device RandomResizedCrop must sample original
+  // pixels, torchvision semantics). With the default shorter-side-512 canvas
+  // nearly all ImageNet photos stage pixel-exact.
+  const float scale = std::min(
+      1.0f, std::min(static_cast<float>(H) / h, static_cast<float>(W) / w));
   const int nh = std::clamp(static_cast<int>(std::lround(h * scale)), 1, H);
   const int nw = std::clamp(static_cast<int>(std::lround(w * scale)), 1, W);
-  // map output pixel -> source coordinate (align-corners=false convention)
-  const float sx = static_cast<float>(w) / nw;
-  const float sy = static_cast<float>(h) / nh;
-  for (int y = 0; y < nh; ++y) {
-    const float fy = (y + 0.5f) * sy - 0.5f;
-    const int y0 = std::clamp(static_cast<int>(std::floor(fy)), 0, h - 1);
-    const int y1 = std::min(y0 + 1, h - 1);
-    const float wy = std::clamp(fy - y0, 0.0f, 1.0f);
-    uint8_t* row = dst + static_cast<size_t>(y) * W * 3;
-    for (int x = 0; x < nw; ++x) {
-      const float fx = (x + 0.5f) * sx - 0.5f;
-      const int x0 = std::clamp(static_cast<int>(std::floor(fx)), 0, w - 1);
-      const int x1 = std::min(x0 + 1, w - 1);
-      const float wx = std::clamp(fx - x0, 0.0f, 1.0f);
-      const uint8_t* p00 = src + (static_cast<size_t>(y0) * w + x0) * 3;
-      const uint8_t* p01 = src + (static_cast<size_t>(y0) * w + x1) * 3;
-      const uint8_t* p10 = src + (static_cast<size_t>(y1) * w + x0) * 3;
-      const uint8_t* p11 = src + (static_cast<size_t>(y1) * w + x1) * 3;
-      uint8_t* out = row + static_cast<size_t>(x) * 3;
-      for (int c = 0; c < 3; ++c) {
-        const float top = p00[c] + (p01[c] - p00[c]) * wx;
-        const float bot = p10[c] + (p11[c] - p10[c]) * wx;
-        out[c] = static_cast<uint8_t>(std::lround(top + (bot - top) * wy));
+  if (nh == h && nw == w) {  // pixel-exact paste, no resample
+    for (int y = 0; y < h; ++y) {
+      std::memcpy(dst + static_cast<size_t>(y) * W * 3,
+                  src + static_cast<size_t>(y) * w * 3,
+                  static_cast<size_t>(w) * 3);
+    }
+  } else {
+    // map output pixel -> source coordinate (align-corners=false convention)
+    const float sx = static_cast<float>(w) / nw;
+    const float sy = static_cast<float>(h) / nh;
+    for (int y = 0; y < nh; ++y) {
+      const float fy = (y + 0.5f) * sy - 0.5f;
+      const int y0 = std::clamp(static_cast<int>(std::floor(fy)), 0, h - 1);
+      const int y1 = std::min(y0 + 1, h - 1);
+      const float wy = std::clamp(fy - y0, 0.0f, 1.0f);
+      uint8_t* row = dst + static_cast<size_t>(y) * W * 3;
+      for (int x = 0; x < nw; ++x) {
+        const float fx = (x + 0.5f) * sx - 0.5f;
+        const int x0 = std::clamp(static_cast<int>(std::floor(fx)), 0, w - 1);
+        const int x1 = std::min(x0 + 1, w - 1);
+        const float wx = std::clamp(fx - x0, 0.0f, 1.0f);
+        const uint8_t* p00 = src + (static_cast<size_t>(y0) * w + x0) * 3;
+        const uint8_t* p01 = src + (static_cast<size_t>(y0) * w + x1) * 3;
+        const uint8_t* p10 = src + (static_cast<size_t>(y1) * w + x0) * 3;
+        const uint8_t* p11 = src + (static_cast<size_t>(y1) * w + x1) * 3;
+        uint8_t* out = row + static_cast<size_t>(x) * 3;
+        for (int c = 0; c < 3; ++c) {
+          const float top = p00[c] + (p01[c] - p00[c]) * wx;
+          const float bot = p10[c] + (p11[c] - p10[c]) * wx;
+          out[c] = static_cast<uint8_t>(std::lround(top + (bot - top) * wy));
+        }
       }
     }
-    // edge-replicate the right padding so on-device crop taps at the content
-    // boundary read clamped pixels (PIL semantics), not black
+  }
+  // edge-replicate padding so on-device crop taps at the content boundary
+  // read clamped pixels (PIL semantics), never black
+  for (int y = 0; y < nh; ++y) {
+    uint8_t* row = dst + static_cast<size_t>(y) * W * 3;
     const uint8_t* last = row + static_cast<size_t>(nw - 1) * 3;
     for (int x = nw; x < W; ++x) {
       std::memcpy(row + static_cast<size_t>(x) * 3, last, 3);

@@ -36,7 +36,7 @@ def test_v3_through_driver(mesh8, tmp_path):
     assert int(state.step) == 16
     assert np.isfinite(metrics["loss"])
     assert "momentum" in metrics  # the v3 cosine ramp is live
-    assert 0.0 < metrics["knn_top1"] <= 1.0
+    assert 0.0 < metrics["knn_train_top1"] <= 1.0
     assert state.queue is None
     assert os.path.exists(config.export_path)
 

@@ -1,0 +1,146 @@
+"""Equivalence pins for the fused bn→relu→1x1-conv tail
+(ops/pallas_fused_conv.py + models/fused_block.py; VERDICT r2 #2 lever).
+
+Three layers of proof, all CPU-runnable:
+1. the Pallas kernel (interpret mode) against the plain jnp math;
+2. the custom VJP (closed-form BN chain + recomputed-z matmuls) against
+   autodiff of the unfused composition;
+3. the Bottleneck module with `fused_tail=True`: identical param/stat tree
+   and matching outputs/grads/running-stat updates vs the unfused block.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.models.fused_block import _bn_relu_conv_train
+from moco_tpu.ops.pallas_fused_conv import bn_relu_matmul
+
+
+def _ref_math(x, a, b, w):
+    z = jnp.maximum(x.astype(jnp.float32) * a + b, 0.0)
+    return z @ w.astype(jnp.float32)
+
+
+def test_kernel_matches_reference_interpret():
+    key = jax.random.key(0)
+    m, k, n = 128, 64, 256
+    x = jax.random.normal(jax.random.key(1), (m, k), jnp.float32)
+    a = jax.random.normal(jax.random.key(2), (k,)) * 0.5 + 1.0
+    b = jax.random.normal(jax.random.key(3), (k,)) * 0.1
+    w = jax.random.normal(key, (k, n)) * 0.05
+    got = bn_relu_matmul(x, a, b, w, out_dtype=jnp.float32, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_ref_math(x, a, b, w)), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_kernel_ragged_tiles_interpret():
+    """Tile pickers must handle non-power-of-two dims (fall back to full)."""
+    x = jax.random.normal(jax.random.key(4), (96, 24), jnp.float32)
+    a = jnp.ones((24,))
+    b = jnp.zeros((24,))
+    w = jax.random.normal(jax.random.key(5), (24, 40)) * 0.1
+    got = bn_relu_matmul(x, a, b, w, out_dtype=jnp.float32, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_ref_math(x, a, b, w)), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_custom_vjp_matches_autodiff():
+    """The closed-form backward (BN chain + recomputed-z matmuls) equals
+    autodiff of the unfused normalize→relu→conv composition."""
+    eps = 1e-5
+    x = jax.random.normal(jax.random.key(6), (4, 6, 6, 16), jnp.float32)
+    scale = 1.0 + 0.1 * jax.random.normal(jax.random.key(7), (16,))
+    bias = 0.1 * jax.random.normal(jax.random.key(8), (16,))
+    w = 0.1 * jax.random.normal(jax.random.key(9), (1, 1, 16, 32))
+
+    def unfused(x, scale, bias, w):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.mean(xf * xf, axis=(0, 1, 2)) - mean * mean
+        z = nn.relu((xf - mean) * (jax.lax.rsqrt(var + eps) * scale) + bias)
+        return jax.lax.conv_general_dilated(
+            z, w, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        )
+
+    def loss_fused(args):
+        y, _, _ = _bn_relu_conv_train(*args, eps, jnp.float32)
+        return jnp.sum(y * jnp.cos(y))  # non-trivial cotangent
+
+    def loss_ref(args):
+        y = unfused(*args)
+        return jnp.sum(y * jnp.cos(y))
+
+    args = (x, scale, bias, w)
+    lf, gf = jax.value_and_grad(loss_fused)(args)
+    lr_, gr = jax.value_and_grad(loss_ref)(args)
+    np.testing.assert_allclose(float(lf), float(lr_), rtol=1e-5)
+    for a, b_ in zip(jax.tree.leaves(gf), jax.tree.leaves(gr), strict=True):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-4
+        )
+
+
+@pytest.mark.parametrize("train", [True, False])
+def test_bottleneck_fused_tail_equivalent(train):
+    """Same param/stat tree, same outputs, same grads, same running-stat
+    updates as the unfused Bottleneck (CPU: plain fwd + closed-form bwd)."""
+    from moco_tpu.models.resnet import Bottleneck
+
+    from functools import partial
+
+    conv = partial(nn.Conv, use_bias=False, dtype=jnp.float32,
+                   param_dtype=jnp.float32)
+    norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                   epsilon=1e-5, dtype=jnp.float32, param_dtype=jnp.float32)
+    kw = dict(filters=8, strides=1, conv=conv, norm=norm)
+    plain = Bottleneck(**kw)
+    fused = Bottleneck(fused_tail=True, bn_momentum=0.9, dtype=jnp.float32, **kw)
+    x = jax.random.normal(jax.random.key(10), (2, 8, 8, 32), jnp.float32)
+    v = plain.init(jax.random.key(11), x)
+    v2 = fused.init(jax.random.key(11), x)
+    assert jax.tree.structure(v) == jax.tree.structure(v2)
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_leaves_with_path(v),
+        jax.tree_util.tree_leaves_with_path(v2),
+        strict=True,
+    ):
+        assert la.shape == lb.shape, (pa, la.shape, lb.shape)
+
+    if train:
+        out_a, mut_a = plain.apply(v, x, mutable=["batch_stats"])
+        out_b, mut_b = fused.apply(v, x, mutable=["batch_stats"])
+        for a, b_ in zip(
+            jax.tree.leaves(mut_a), jax.tree.leaves(mut_b), strict=True
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-5, atol=1e-6)
+    else:
+        out_a = plain.apply(v, x)
+        out_b = fused.apply(v, x)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                               rtol=1e-5, atol=1e-5)
+
+    if train:
+        def loss(params, model):
+            out, _ = model.apply(
+                {"params": params, "batch_stats": v["batch_stats"]},
+                x, mutable=["batch_stats"],
+            )
+            return jnp.sum(out ** 2)
+
+        ga = jax.grad(loss)(v["params"], plain)
+        gb = jax.grad(loss)(v["params"], fused)
+        for (pa, a), (pb, b_) in zip(
+            jax.tree_util.tree_leaves_with_path(ga),
+            jax.tree_util.tree_leaves_with_path(gb),
+            strict=True,
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=3e-4, atol=3e-4,
+                err_msg=str(pa),
+            )

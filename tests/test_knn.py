@@ -51,12 +51,19 @@ def test_knn_chunked_matches_unchunked():
         np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
-def test_knn_chunked_k_clamped_to_chunk():
-    bank, bank_labels = _clusters(jax.random.key(9), 50, 4, 16)
-    queries, qlabels = _clusters(jax.random.key(10), 10, 4, 16)
-    pred = knn_predict(queries, bank, bank_labels, num_classes=4, k=64,
-                       bank_chunk=32)  # k clamps to the chunk width
-    np.testing.assert_array_equal(np.asarray(pred), np.asarray(qlabels))
+def test_knn_chunked_k_exceeding_chunk_is_exact():
+    """k > bank_chunk used to silently clamp to the chunk width (ADVICE r2);
+    the merge now carries the full k, so the chunked path agrees with the
+    unchunked protocol for any k ≤ N."""
+    key = jax.random.key(9)
+    bank = jax.random.normal(key, (300, 16))
+    bank_labels = jax.random.randint(jax.random.key(10), (300,), 0, 7)
+    queries = jax.random.normal(jax.random.key(11), (16, 16))
+    for k in (64, 100, 250):
+        ref = knn_predict(queries, bank, bank_labels, num_classes=7, k=k)
+        got = knn_predict(queries, bank, bank_labels, num_classes=7, k=k,
+                          bank_chunk=32)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
 def test_knn_imagenet_scale_bank():

@@ -152,3 +152,35 @@ def test_s2d_stem_falls_back_on_odd_sizes():
     v = model.init(jax.random.key(0), x, train=False)
     out = model.apply(v, x, train=False)
     assert out.shape == (2, 16)
+
+
+def test_remat_blocks_identical_outputs_and_grads():
+    """Per-block rematerialization is a pure memory/compute trade: the same
+    ops re-executed in the backward — outputs and gradients must be
+    IDENTICAL to the unrematted model (param tree included)."""
+    from moco_tpu.models.resnet import BasicBlock, ResNet
+
+    kw = dict(stage_sizes=(1, 1), block_cls=BasicBlock, width=8,
+              num_classes=16, cifar_stem=True)
+    plain = ResNet(remat=False, **kw)
+    rm = ResNet(remat=True, **kw)
+    x = jax.random.normal(jax.random.key(0), (2, 16, 16, 3))
+    v = plain.init(jax.random.key(1), x, train=False)
+    assert jax.tree.structure(v) == jax.tree.structure(
+        rm.init(jax.random.key(1), x, train=False)
+    )
+    out_a = plain.apply(v, x, train=False)
+    out_b = rm.apply(v, x, train=False)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+
+    def loss(params, model):
+        out, _ = model.apply(
+            {"params": params, "batch_stats": v["batch_stats"]},
+            x, train=True, mutable=["batch_stats"],
+        )
+        return jnp.sum(out ** 2)
+
+    ga = jax.grad(loss)(v["params"], plain)
+    gb = jax.grad(loss)(v["params"], rm)
+    for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb), strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -47,7 +47,7 @@ def test_moco_v1_smoke_loss_falls_knn_above_chance(trained):
     # 0.95-0.99 here across seeds (runs/README.md; 3-seed r2 measurement),
     # so 0.9 catches subtle algorithmic regressions (aug order, EMA rate)
     # that the old above-chance bar (0.2) would have passed
-    assert metrics["knn_top1"] > 0.9, f"kNN top-1 {metrics['knn_top1']} below healthy range"
+    assert metrics["knn_train_top1"] > 0.9, f"kNN top-1 {metrics['knn_train_top1']} below healthy range"
     assert os.path.exists(export)
     try:
         import tensorboardX  # noqa: F401  (optional dep; writer no-ops without it)

@@ -167,7 +167,8 @@ def test_v3_r50_lars_step_on_mesh(mesh8):
     preset = get_preset("imagenet-moco-v3-r50")
     assert preset.optimizer == "lars" and preset.variant == "v3"
     assert preset.weight_decay == 1.5e-6 and preset.crop_min == 0.2
-    assert preset.lr == pytest.approx(0.3 * preset.batch_size / 256)
+    # lr follows the linear-scaling rule from the ACTUAL batch (base_lr)
+    assert preset.effective_lr == pytest.approx(0.3 * preset.batch_size / 256)
 
     def run(optimizer):
         config = preset.replace(

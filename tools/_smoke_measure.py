@@ -1,3 +1,5 @@
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 import json, sys
 from moco_tpu.parallel.mesh import force_cpu_devices
 force_cpu_devices(8)
@@ -12,6 +14,6 @@ for seed in (0, 1, 2):
         print_freq=9999, seed=seed,
     )
     state, metrics = train(cfg)
-    res.append(round(metrics["knn_top1"], 4))
-    print("seed", seed, "knn", metrics["knn_top1"], flush=True)
+    res.append(round(metrics["knn_train_top1"], 4))
+    print("seed", seed, "knn", metrics["knn_train_top1"], flush=True)
 print(json.dumps(res))

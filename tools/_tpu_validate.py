@@ -2,8 +2,11 @@
 1) fast_bn/pallas-stats numerics on TPU vs jnp
 2) s2d stem on TPU matches plain conv
 3) fused-step timing at B=128 and B=256
-4) train a few steps: loss finite and falling trend vs old path
+4) train a few steps: record the first losses (finite, reference-magnitude)
+   alongside the timing sweep
 """
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 import time, sys
 import jax, jax.numpy as jnp, numpy as np
 
