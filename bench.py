@@ -77,14 +77,17 @@ def orchestrate(mode: str) -> None:
     errors = []
     # input mode never needs an accelerator: run it on the CPU backend only
     attempts = (
-        [("cpu", {"JAX_PLATFORMS": "cpu"}, 1200.0)]
+        # MOCO_TPU_FORCE_CPU (not JAX_PLATFORMS): the sandbox sitecustomize
+        # force-registers the axon TPU platform and overrides the env var, so
+        # the child must switch platforms IN-PROCESS via jax.config
+        [("cpu", {"MOCO_TPU_FORCE_CPU": "1"}, 1200.0)]
         if mode == "input"
         else [
             ("tpu", {}, 1500.0),     # first compile on the relay is slow
             # retry with the newest Pallas path disabled, in case a Mosaic
             # compile failure (not a backend outage) killed attempt 1
             ("tpu-retry", {"MOCO_TPU_DISABLE_FUSED": "1"}, 900.0),
-            ("cpu-proxy", {"JAX_PLATFORMS": "cpu"}, 1200.0),
+            ("cpu-proxy", {"MOCO_TPU_FORCE_CPU": "1"}, 1200.0),
         ]
     )
     for name, env_extra, timeout_s in attempts:
@@ -395,9 +398,16 @@ if __name__ == "__main__":
     args = parser.parse_args()
     if not args.child:
         orchestrate(args.mode)
-    elif args.mode == "input":
-        bench_input()
-    elif args.mode == "e2e":
-        bench_e2e()
     else:
-        main()
+        if os.environ.get("MOCO_TPU_FORCE_CPU"):
+            # in-process platform switch — the sitecustomize overrides
+            # JAX_PLATFORMS, and the axon backend can hang device init
+            from moco_tpu.parallel.mesh import force_cpu_devices
+
+            force_cpu_devices(1)
+        if args.mode == "input":
+            bench_input()
+        elif args.mode == "e2e":
+            bench_e2e()
+        else:
+            main()

@@ -19,6 +19,10 @@ TPU-first choices:
 
 Structure parity notes (vs torchvision `resnet.py`):
 - Bottleneck is v1.5: the stride sits on the 3x3 conv, not the 1x1.
+- 3x3 convs use EXPLICIT symmetric padding 1 (torch semantics): flax's
+  default SAME pads (0,1) at stride 2, a one-pixel tap shift that would
+  make exported checkpoints run a slightly different network in torch
+  consumers (pinned by tests/test_torch_consumer.py against real torch).
 - Stem: 7x7/2 conv, BN, ReLU, 3x3/2 max-pool. `cifar_stem=True` swaps in the
   community CIFAR variant (3x3/1 conv, no max-pool) used by every CIFAR MoCo
   demo (BASELINE config 1).
@@ -87,10 +91,17 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), (self.strides, self.strides), name="conv1")(x)
+        # explicit pad 1 on 3x3 convs: flax's default SAME pads (0,1) at
+        # stride 2 — a one-pixel tap shift vs torchvision's symmetric
+        # padding=1 at every stage transition, which would make exported
+        # checkpoints run a (slightly) different network in torch consumers
+        y = self.conv(
+            self.filters, (3, 3), (self.strides, self.strides),
+            padding=[(1, 1), (1, 1)], name="conv1",
+        )(x)
         y = self.norm(name="bn1")(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), name="conv2")(y)
+        y = self.conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)], name="conv2")(y)
         y = self.norm(name="bn2")(y)
         if residual.shape != y.shape:
             residual = self.conv(
@@ -123,7 +134,11 @@ class Bottleneck(nn.Module):
         y = self.conv(self.filters, (1, 1), name="conv1")(x)
         y = self.norm(name="bn1")(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), (self.strides, self.strides), name="conv2")(y)
+        # explicit pad 1: torchvision-symmetric (see BasicBlock note)
+        y = self.conv(
+            self.filters, (3, 3), (self.strides, self.strides),
+            padding=[(1, 1), (1, 1)], name="conv2",
+        )(y)
         if self.fused_tail:
             from moco_tpu.models.fused_block import fused_bn_relu_conv3
 

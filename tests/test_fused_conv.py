@@ -144,3 +144,41 @@ def test_bottleneck_fused_tail_equivalent(train):
                 np.asarray(a), np.asarray(b_), rtol=3e-4, atol=3e-4,
                 err_msg=str(pa),
             )
+
+
+def test_fused_tail_inside_shard_map_step(mesh8):
+    """The fused custom-VJP tail composes with the full hybrid jit/shard_map
+    v2 training step (manual params + running-stat updates + donation +
+    pmean'd grads). The backend gate is patched so the fused DECLARATION
+    path runs here with the jnp fallback math (the Pallas lowering itself is
+    TPU-only and covered by interpret-mode tests above)."""
+    import unittest.mock as mock
+
+    import moco_tpu.models.fast_bn as fbn
+    import moco_tpu.models.fused_block as fb
+    from moco_tpu.config import PretrainConfig
+    from moco_tpu.models.resnet import Bottleneck, ResNet
+    from moco_tpu.train_state import create_train_state
+    from moco_tpu.train_step import build_optimizer, build_train_step
+
+    B, IMG, DIM, K = 16, 16, 16, 64
+    config = PretrainConfig(variant="v1", arch="resnet_tiny", cifar_stem=True,
+                            num_negatives=K, embed_dim=DIM, batch_size=B, lr=0.1)
+    model = ResNet(stage_sizes=(1, 1), block_cls=Bottleneck, width=8,
+                   num_classes=DIM, cifar_stem=True, fused_bn_conv=True)
+    tx, sched = build_optimizer(config, 8)
+    with mock.patch.object(jax, "default_backend", lambda: "tpu"), \
+         mock.patch.object(fb, "_use_pallas", lambda: False), \
+         mock.patch.object(fbn, "_use_pallas", lambda: False):
+        state = create_train_state(
+            jax.random.key(0), model, tx, (2, IMG, IMG, 3), K, DIM
+        )
+        step = build_train_step(config, model, tx, mesh8, 8, sched)
+        im_q = jax.random.normal(jax.random.key(1), (B, IMG, IMG, 3))
+        im_k = jax.random.normal(jax.random.key(2), (B, IMG, IMG, 3))
+        state, metrics = step(state, im_q, im_k)
+        state, metrics = step(state, im_q, im_k)
+    assert np.isfinite(float(metrics["loss"]))
+    # the fused tail's running stats live exactly where bn2's would
+    assert "bn2" in state.batch_stats_q["layer1_0"]
+    assert int(state.step) == 2
