@@ -101,8 +101,13 @@ def knn_monitor(config, feature_fn, state, dataset, mesh=None) -> float:
     )
 
 
-def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
-    """Run pretraining; returns (final_state, last_metrics_dict)."""
+def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
+          dataset=None):
+    """Run pretraining; returns (final_state, last_metrics_dict).
+
+    `dataset` overrides the config-built one (callers that need a custom
+    size/source, e.g. the horizon runs, without widening the flag surface).
+    """
     if mesh is None:
         mesh = create_mesh()
     if config.debug_nans:
@@ -112,13 +117,23 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None):
     n_chips = mesh.size
     local_b = local_batch_size(config.batch_size, mesh)  # validates divisibility
 
-    dataset = build_dataset(
-        config.dataset, config.data_dir, image_size=config.image_size,
-        stage_size=config.stage_size, num_workers=config.num_workers,
-    )
-    steps_per_epoch = config.steps_per_epoch or max(
-        len(dataset) // config.batch_size, 1
-    )
+    if dataset is None:
+        dataset = build_dataset(
+            config.dataset, config.data_dir, image_size=config.image_size,
+            stage_size=config.stage_size, num_workers=config.num_workers,
+        )
+    # clamp to the batches the loader can actually yield: a steps_per_epoch
+    # above that silently truncated epochs (and stretched the lr schedule) —
+    # the r2 "3200-step" horizon run actually ran 768 steps this way
+    available = max(len(dataset) // config.batch_size, 1)
+    steps_per_epoch = min(config.steps_per_epoch or available, available)
+    if config.steps_per_epoch and steps_per_epoch < config.steps_per_epoch:
+        print(
+            f"steps_per_epoch clamped {config.steps_per_epoch} -> "
+            f"{steps_per_epoch}: the {len(dataset)}-sample dataset yields only "
+            f"{available} batches of {config.batch_size}",
+            flush=True,
+        )
 
     model = build_encoder(config)
     tx, sched = build_optimizer(config, steps_per_epoch)

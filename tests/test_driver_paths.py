@@ -119,3 +119,18 @@ def test_imagefolder_through_driver(mesh8, tmp_path):
     state, metrics = train(config, mesh8)
     assert int(state.step) == 2
     assert np.isfinite(metrics["loss"])
+
+
+@pytest.mark.slow
+def test_steps_per_epoch_clamped_to_loader(mesh8):
+    """A steps_per_epoch above what the dataset can yield used to silently
+    truncate epochs (and stretch the lr schedule); it now clamps to the
+    loader's real batch count, so configured epochs mean what they say."""
+    config = get_preset("cifar10-moco-v1").replace(
+        arch="resnet_tiny", dataset="synthetic", image_size=16,
+        batch_size=256, num_negatives=512, embed_dim=16,
+        epochs=2, steps_per_epoch=10_000,   # >> 2048/256 = 8 available
+        knn_monitor=False, ckpt_dir="", print_freq=100,
+    )
+    state, _ = train(config, mesh8)
+    assert int(state.step) == 2 * 8  # 2 real epochs of the 8 real batches

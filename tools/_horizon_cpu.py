@@ -20,7 +20,7 @@ lr = float(sys.argv[1]) if len(sys.argv) > 1 else 0.03
 cfg = get_preset("cifar10-moco-v1").replace(
     arch="resnet_tiny", cifar_stem=True, dataset="synthetic", image_size=16,
     batch_size=64, num_negatives=512, embed_dim=32, lr=lr, cos=True,
-    epochs=24, steps_per_epoch=64,   # 1536 steps
+    epochs=24, steps_per_epoch=None,  # 2048/64 = 32 steps x 24 epochs = 768
     knn_monitor=True, knn_bank_size=1024, num_classes=10,
     ckpt_dir="", tb_dir="", print_freq=9999, num_workers=1,
 )
