@@ -36,6 +36,10 @@ class PretrainConfig:
     remat: bool = False               # per-block rematerialization (ViT
                                       # blocks / ResNet residual blocks):
                                       # trades recompute for HBM traffic
+    zero_sharding: bool = False       # ZeRO-1: shard optimizer state over
+                                      # the data axis (HBM/N footprint, one
+                                      # all-gather of updates per step;
+                                      # identical numerics — parallel/zero)
     fused_bn_conv: bool = True        # Bottleneck bn2→relu→conv3 through the
                                       # Pallas fused kernel on TPU (identical
                                       # params and math; models/fused_block)
@@ -223,6 +227,31 @@ PRESETS: dict[str, PretrainConfig | EvalConfig] = {
         warmup_epochs=40,
         cos=True,
         aug_plus=True,
+        dataset="imagefolder",
+        compute_dtype="bfloat16",
+    ),
+    # 5a. MoCo-v3 ViT-B/16 — the sibling repo's larger ViT run (same AdamW
+    #     recipe as ViT-S: lr 1.5e-4·b/256, wd 0.1, batch 4096, 40-epoch
+    #     warmup; only the backbone width/depth changes). remat on by
+    #     default: ViT-B at per-chip batch 512 needs it to fit HBM.
+    "imagenet-moco-v3-vitb": PretrainConfig(
+        name="imagenet-moco-v3-vitb",
+        variant="v3",
+        arch="vit_base",
+        embed_dim=256,
+        momentum_ema=0.99,
+        momentum_ramp=True,
+        temperature=0.2,
+        optimizer="adamw",
+        lr=0.0,
+        base_lr=1.5e-4,
+        weight_decay=0.1,
+        batch_size=4096,
+        epochs=300,
+        warmup_epochs=40,
+        cos=True,
+        aug_plus=True,
+        remat=True,
         dataset="imagefolder",
         compute_dtype="bfloat16",
     ),

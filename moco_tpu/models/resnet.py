@@ -293,6 +293,7 @@ ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
 ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
 ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck)
 ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=Bottleneck)
+ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3), block_cls=Bottleneck)
 
 # 2-stage, width-16 micro-ResNet: smoke tests / CI on the single-core CPU
 # sandbox, where a full ResNet-18 compile is minutes. Not a reference arch.
@@ -304,6 +305,7 @@ ARCHS: dict[str, Callable[..., ResNet]] = {
     "resnet34": ResNet34,
     "resnet50": ResNet50,
     "resnet101": ResNet101,
+    "resnet152": ResNet152,
     "resnet_tiny": ResNetTiny,
 }
 
@@ -312,6 +314,7 @@ FEATURE_DIMS = {
     "resnet34": 512,
     "resnet50": 2048,
     "resnet101": 2048,
+    "resnet152": 2048,
     "resnet_tiny": 32,
 }
 

@@ -75,30 +75,78 @@ def make_feature_fn(model, variant: str):
     return feature_fn
 
 
-def knn_monitor(config, feature_fn, state, dataset, mesh=None) -> float:
-    """Periodic kNN top-1 on held-out-ish data (SURVEY §2.5 protocol at
-    monitoring scale: embed a train subset as the bank, score a val subset).
-    `feature_fn` comes from `make_feature_fn` ONCE per run (recompiling the
-    eval forward every epoch costs minutes on the sandbox)."""
+def knn_monitor(
+    config, feature_fn, state, dataset, mesh=None, val_dataset=None
+) -> tuple[float, bool]:
+    """Periodic kNN top-1 (SURVEY §2.5 protocol at monitoring scale). The
+    bank is a train subset; queries come from `val_dataset` when one exists
+    (imagefolder `val/`, CIFAR test split) — a REAL val metric — else from a
+    held-out train slice (logged as `knn_train_top1`). Returns
+    (accuracy, is_real_val). `feature_fn` comes from `make_feature_fn` ONCE
+    per run (recompiling the eval forward every epoch costs minutes on the
+    sandbox)."""
     from moco_tpu.evals.knn import encode_dataset
 
     n = min(len(dataset), config.knn_bank_size)
-    split = int(n * 0.8)
     rng = np.random.RandomState(config.seed)
     idx = rng.permutation(len(dataset))[:n]
+    if val_dataset is not None:
+        bank_idx = idx
+        q_set = val_dataset
+        q_idx = rng.permutation(len(val_dataset))[: max(n // 4, 1)]
+    else:
+        split = int(n * 0.8)
+        bank_idx, q_idx = idx[:split], idx[split:]
+        q_set = dataset
     bank, bank_labels = encode_dataset(
         None, state.params_q, state.batch_stats_q, dataset, config,
-        indices=idx[:split], feature_fn=feature_fn, mesh=mesh,
+        indices=bank_idx, feature_fn=feature_fn, mesh=mesh,
     )
     val, val_labels = encode_dataset(
-        None, state.params_q, state.batch_stats_q, dataset, config,
-        indices=idx[split:], feature_fn=feature_fn, mesh=mesh,
+        None, state.params_q, state.batch_stats_q, q_set, config,
+        indices=q_idx, feature_fn=feature_fn, mesh=mesh,
     )
-    return knn_accuracy(
+    acc = knn_accuracy(
         jnp.asarray(val), jnp.asarray(val_labels), jnp.asarray(bank),
         jnp.asarray(bank_labels), num_classes=dataset.num_classes,
-        k=min(200, split), temperature=0.07,
+        k=min(200, len(bank_idx)), temperature=0.07,
     )
+    return acc, val_dataset is not None
+
+
+def _monitor_val_split(config, train_dataset):
+    """A real validation split for the kNN monitor, when the dataset has
+    one: imagefolder `val/` dir or the CIFAR-10 test batch. None otherwise
+    (synthetic / no val dir) — the monitor then holds out train data.
+
+    The val split must share the train split's label space: ImageFolder
+    derives class ids from its own directory listing, so a partial or
+    differently-listed `val/` would silently shift every label. Mismatched
+    class maps fall back to the train hold-out with a visible notice."""
+    import os
+
+    if config.dataset == "imagefolder":
+        val_dir = os.path.join(config.data_dir, "val")
+        if os.path.isdir(val_dir):
+            val = build_dataset(
+                "imagefolder", val_dir, image_size=config.image_size,
+                stage_size=config.stage_size, num_workers=config.num_workers,
+            )
+            if val.class_to_idx != getattr(train_dataset, "class_to_idx", None):
+                print(
+                    "kNN monitor: val/ class directories differ from train/ "
+                    "— labels would misalign; falling back to a train "
+                    "hold-out split",
+                    flush=True,
+                )
+                return None
+            return val
+    if config.dataset == "cifar10":
+        try:
+            return build_dataset("cifar10", config.data_dir, train=False)
+        except FileNotFoundError:
+            return None
+    return None
 
 
 def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
@@ -164,6 +212,13 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
         from moco_tpu.parallel.mesh import replicated
 
         state = maybe_resume(mgr, state, config.resume, sharding=replicated(mesh))
+    if config.zero_sharding:
+        # ZeRO-1 (after any resume, so the placement survives it): optimizer
+        # state sharded over the data axis; jit propagates the committed
+        # input shardings through every subsequent step
+        from moco_tpu.parallel.zero import shard_opt_state
+
+        state = state.replace(opt_state=shard_opt_state(state.opt_state, mesh))
 
     if config.variant == "v3":
         # asymmetric view pair; crop_min is the repo's --crop-min knob
@@ -197,6 +252,7 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
     total_steps = max_steps or config.epochs * steps_per_epoch
     last_metrics: dict = {}
     feature_fn = make_feature_fn(model, config.variant) if config.knn_monitor else None
+    monitor_val = _monitor_val_split(config, dataset) if config.knn_monitor else None
     # observability on process 0 only: every host writing the same tags into
     # one tb_dir duplicates curves, and concurrent profiler traces race
     is_main = jax.process_index() == 0
@@ -268,13 +324,19 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
                 flush=True,
             )
             if config.knn_monitor:
-                acc = knn_monitor(config, feature_fn, state, dataset, mesh)
-                # the monitor's "held-out" split is carved from the TRAIN set
-                # (no val set is plumbed during pretrain); the tag says so to
-                # avoid misreading it as a val metric
-                last_metrics["knn_train_top1"] = acc
-                print(f"Epoch [{epoch}] kNN(train) top-1 {100 * acc:.2f}%", flush=True)
-                writer.write(global_step, {"knn_train_top1": acc})
+                acc, is_val = knn_monitor(
+                    config, feature_fn, state, dataset, mesh,
+                    val_dataset=monitor_val,
+                )
+                # with a real val split the tag is a true val metric;
+                # otherwise the held-out slice comes from the TRAIN set and
+                # the tag says so, to avoid misreading it
+                tag = "knn_val_top1" if is_val else "knn_train_top1"
+                label = "val" if is_val else "train"
+                last_metrics[tag] = acc
+                print(f"Epoch [{epoch}] kNN({label}) top-1 {100 * acc:.2f}%",
+                      flush=True)
+                writer.write(global_step, {tag: acc})
             if mgr is not None and (epoch + 1) % config.ckpt_every_epochs == 0:
                 # unlike the reference's rank-0-only torch.save, Orbax saving of
                 # multi-process arrays is COLLECTIVE — every process must call it

@@ -134,3 +134,53 @@ def test_steps_per_epoch_clamped_to_loader(mesh8):
     )
     state, _ = train(config, mesh8)
     assert int(state.step) == 2 * 8  # 2 real epochs of the 8 real batches
+
+
+@pytest.mark.slow
+def test_knn_monitor_uses_val_split_when_present(mesh8, tmp_path):
+    """With an imagefolder val/ dir the monitor reports a REAL val metric
+    (knn_val_top1); without one it holds out train data (knn_train_top1)."""
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    rng = np.random.RandomState(1)
+    colors = [(220, 30, 30), (30, 220, 30), (30, 30, 220)]
+    for split, count in (("train", 12), ("val", 6)):
+        for c, color in enumerate(colors):
+            d = tmp_path / "data" / split / f"class{c}"
+            d.mkdir(parents=True)
+            for i in range(count):
+                img = np.clip(
+                    np.array(color)[None, None] + rng.randint(-25, 25, (32, 32, 3)),
+                    0, 255,
+                ).astype(np.uint8)
+                Image.fromarray(img).save(str(d / f"{i}.jpg"), quality=90)
+
+    config = get_preset("cifar10-moco-v1").replace(
+        arch="resnet_tiny",
+        dataset="imagefolder",
+        data_dir=str(tmp_path / "data"),
+        image_size=16,
+        batch_size=32,
+        num_negatives=64,
+        embed_dim=16,
+        epochs=1,
+        knn_monitor=True,
+        knn_bank_size=36,
+        ckpt_dir="",
+        print_freq=1,
+        num_classes=3,
+    )
+    _, metrics = train(config, mesh8)
+    assert "knn_val_top1" in metrics and "knn_train_top1" not in metrics
+    assert 0.0 <= metrics["knn_val_top1"] <= 1.0
+
+    # a val/ whose class listing differs from train/ would shift every
+    # label id — the monitor must refuse it and fall back to the train
+    # hold-out (labeled accordingly)
+    extra = tmp_path / "data" / "val" / "class_extra"
+    extra.mkdir()
+    img = np.full((32, 32, 3), 128, np.uint8)
+    Image.fromarray(img).save(str(extra / "0.jpg"), quality=90)
+    _, metrics = train(config, mesh8)
+    assert "knn_train_top1" in metrics and "knn_val_top1" not in metrics
