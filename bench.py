@@ -159,30 +159,35 @@ def bench_input():
     detail = {}
     best = 0.0
     try:
-        for threads in sorted({1, 2, 4, max(1, ncpu)}):
-            loader = NativeStagingLoader(256, 512, threads)
-            loader.load_batch(paths[:32])  # warm the pool
-            t0 = time.perf_counter()
-            _, _, failures = loader.load_batch(paths)
-            dt = time.perf_counter() - t0
-            assert failures == 0
-            rate = len(paths) / dt
-            detail[f"native_{threads}t"] = round(rate, 1)
-            best = max(best, rate)
+        # both canvases: 256 (r2 default) and 512 (the full-resolution
+        # default — typical ImageNet photos stage pixel-exact, VERDICT r2
+        # #4's measured-cost requirement)
+        for stage in (256, 512):
+            for threads in sorted({1, 2, 4, max(1, ncpu)}):
+                loader = NativeStagingLoader(stage, stage * 2, threads)
+                loader.load_batch(paths[:32])  # warm the pool
+                t0 = time.perf_counter()
+                _, _, failures = loader.load_batch(paths)
+                dt = time.perf_counter() - t0
+                assert failures == 0
+                rate = len(paths) / dt
+                detail[f"native_s{stage}_{threads}t"] = round(rate, 1)
+                if stage == 512:  # headline = the shipping default
+                    best = max(best, rate)
     except RuntimeError as e:
         # no native toolchain on this host: report the PIL path alone,
         # mirroring ImageFolder's backend="auto" degradation
         detail["native_unavailable"] = str(e)
-    folder = ImageFolder(root, stage_size=256, backend="pil", num_workers=1)
+    folder = ImageFolder(root, backend="pil", num_workers=1)  # default 512
     sub = np.arange(min(64, len(folder)))
     folder.get_batch(sub[:8])
     t0 = time.perf_counter()
     folder.get_batch(sub)
-    detail["pil_1w"] = round(len(sub) / (time.perf_counter() - t0), 1)
-    best = max(best, detail["pil_1w"])
+    detail["pil_s512_1w"] = round(len(sub) / (time.perf_counter() - t0), 1)
+    best = max(best, detail["pil_s512_1w"])
     # the input-path question (SURVEY §7 hard-part 4): one 8-chip host must
     # stage ~8*step_rate imgs/s; report how many of THESE cores that takes
-    per_core = detail.get("native_1t", detail["pil_1w"])
+    per_core = detail.get("native_s512_1t", detail["pil_s512_1w"])
     print(
         json.dumps(
             {
@@ -221,6 +226,9 @@ def bench_e2e():
     root = tempfile.mkdtemp(prefix="bench_e2e_")
     batch = (128 if on_tpu else 8) * n_chips
     _make_jpeg_tree(root, n_images=batch * 4)
+    # TPU: the shipping full-resolution default (512 canvas); CPU proxy
+    # keeps the smaller canvas so the tiny-model proxy stays fast
+    stage_size = 0 if on_tpu else 256
     if on_tpu:
         config = get_preset("imagenet-moco-v2").replace(batch_size=batch)
         if os.environ.get("MOCO_TPU_DISABLE_FUSED"):
@@ -233,7 +241,7 @@ def bench_e2e():
             embed_dim=32,
         )
         steps = 3
-    dataset = ImageFolder(root, stage_size=256)
+    dataset = ImageFolder(root, **({"stage_size": stage_size} if stage_size else {}))
     model = build_encoder(config)
     tx, sched = build_optimizer(config, steps_per_epoch=1000)
     state = create_train_state(

@@ -142,6 +142,9 @@ class EvalConfig:
     print_freq: int = 10
     ckpt_dir: str = "lincls_checkpoints"  # probe checkpoints ("" = off)
     resume: str = ""                      # "" | "auto" (latest probe ckpt)
+    evaluate: bool = False                # -e/--evaluate: validate the
+                                          # (resumed) probe and exit, no
+                                          # training (`main_lincls.py:≈L95`)
 
     def replace(self, **kw) -> "EvalConfig":
         return dataclasses.replace(self, **kw)

@@ -110,9 +110,13 @@ def run_knn(config: EvalConfig, mesh=None) -> float:
 
 
 def main(argv=None):
-    from moco_tpu.config import add_config_flags, collect_overrides
+    from moco_tpu.config import PRESETS, add_config_flags, collect_overrides, get_preset
 
     parser = argparse.ArgumentParser(description="moco_tpu kNN evaluation")
+    eval_presets = sorted(
+        n for n, c in PRESETS.items() if isinstance(c, EvalConfig)
+    )
+    parser.add_argument("--preset", default="imagenet-lincls", choices=eval_presets)
     add_config_flags(parser, EvalConfig)
     parser.add_argument("--fake-devices", type=int, default=0)
     args = parser.parse_args(argv)
@@ -120,7 +124,7 @@ def main(argv=None):
         from moco_tpu.parallel.mesh import force_cpu_devices
 
         force_cpu_devices(args.fake_devices)
-    run_knn(EvalConfig().replace(**collect_overrides(args, EvalConfig)))
+    run_knn(get_preset(args.preset).replace(**collect_overrides(args, EvalConfig)))
 
 
 if __name__ == "__main__":

@@ -271,6 +271,14 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
             start_epoch = mgr.latest_step() // steps_per_epoch
             step = start_epoch * steps_per_epoch
 
+    if config.evaluate:
+        # reference `-e/--evaluate`: one center-crop validation pass over
+        # the (resumed) probe, no training (`main_lincls.py:≈L95, ≈L280`)
+        acc1, acc5 = validate(eval_step, fc, backbone_params, backbone_stats,
+                              val_set, config, mesh)
+        print(f"Evaluate: val Acc@1 {acc1:.2f} Acc@5 {acc5:.2f}", flush=True)
+        return fc, acc1
+
     for epoch in range(start_epoch, config.epochs):
         losses = AverageMeter("Loss", ":.4e")
         top1 = AverageMeter("Acc@1", ":6.2f")

@@ -18,8 +18,10 @@ partitioner insert the collectives:
 
 Parameters/BN stats/queue stay replicated: MoCo's encoders fit per-chip
 (SURVEY §2.11 keeps TP out of scope), and the queue must be replicated for
-the identical-enqueue invariant. Leaves whose every axis is indivisible by
-the mesh (biases, scalars, step counts) stay replicated too.
+the identical-enqueue invariant. Any optimizer leaf WITH a mesh-divisible
+axis shards (including mesh-divisible 1-D bias/BN momenta); only leaves
+with no such axis (scalars, step counts, odd-sized vectors) stay
+replicated.
 
 Enable with `--zero-sharding true`; `jax.jit` propagates the committed input
 shardings, so no step-function changes are needed.

@@ -128,10 +128,13 @@ def _monitor_val_split(config, train_dataset):
     if config.dataset == "imagefolder":
         val_dir = os.path.join(config.data_dir, "val")
         if os.path.isdir(val_dir):
-            val = build_dataset(
-                "imagefolder", val_dir, image_size=config.image_size,
-                stage_size=config.stage_size, num_workers=config.num_workers,
-            )
+            try:
+                val = build_dataset(
+                    "imagefolder", val_dir, image_size=config.image_size,
+                    stage_size=config.stage_size, num_workers=config.num_workers,
+                )
+            except FileNotFoundError:
+                return None  # empty val/ placeholder: no class subdirs
             if val.class_to_idx != getattr(train_dataset, "class_to_idx", None):
                 print(
                     "kNN monitor: val/ class directories differ from train/ "

@@ -122,3 +122,19 @@ def test_lincls_checkpoint_resume(mesh8, exported_ckpt, tmp_path):
 
     with _pytest.raises(ValueError, match="requires a ckpt_dir"):
         train_lincls(cfg.replace(ckpt_dir="", resume="auto"), mesh8, max_steps=1)
+
+
+@pytest.mark.slow
+def test_lincls_evaluate_only(mesh8, exported_ckpt, tmp_path):
+    """--evaluate (reference -e): validate the resumed probe, no training —
+    the returned acc matches the training run's last validation, and the
+    classifier is untouched."""
+    cfg = eval_config(exported_ckpt, ckpt_dir=str(tmp_path / "probe"), epochs=1)
+    fc_trained, best = train_lincls(cfg, mesh8, max_steps=32)
+    fc_eval, acc = train_lincls(
+        cfg.replace(resume="auto", evaluate=True), mesh8
+    )
+    assert acc == pytest.approx(best, abs=1e-6)
+    for a, b in zip(jax.tree.leaves(fc_trained), jax.tree.leaves(fc_eval),
+                    strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
