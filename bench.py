@@ -270,7 +270,8 @@ def bench_e2e():
                     break
         finally:
             loader.close()
-        float(metrics["loss"])  # d2h sync (block_until_ready lies on the relay)
+        loss = float(metrics["loss"])  # d2h sync (block_until_ready lies on the relay)
+        assert np.isfinite(loss), f"non-finite e2e loss {loss}"
         return n
 
     run_epoch(0, 2)  # compile + relay warmup
@@ -367,15 +368,18 @@ def main():
     #   final sync amortize the ~70 ms relay round-trip.
     for i in range(warmup):
         state, metrics = one_step(state, i)
-    float(metrics["loss"])
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss), f"non-finite warmup loss {loss}"
 
     best = float("inf")
     for r in range(2):  # best-of-2 rounds to dodge relay noise
         t0 = time.perf_counter()
         for i in range(steps):
             state, metrics = one_step(state, (r + 1) * 1000 + i)
-        float(metrics["loss"])
+        loss = float(metrics["loss"])
         best = min(best, (time.perf_counter() - t0) / steps)
+    # a fast-but-wrong kernel must not publish a number
+    assert np.isfinite(loss), f"non-finite benchmark loss {loss}"
 
     imgs_per_sec = config.batch_size / best
     per_chip = imgs_per_sec / n_chips
@@ -388,6 +392,8 @@ def main():
                 "value": round(per_chip, 2),
                 "unit": "imgs/sec/chip",
                 "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+                "fused_bn_conv": bool(config.fused_bn_conv),
+                "final_loss": round(loss, 4),
             }
         )
     )
