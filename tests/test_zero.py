@@ -125,3 +125,38 @@ def test_zero_through_driver(mesh8):
     state, metrics = train(config, mesh8)
     assert int(state.step) == 4
     assert np.isfinite(metrics["loss"])
+
+
+@pytest.mark.slow
+def test_zero_checkpoint_roundtrip(mesh8, tmp_path):
+    """A ZeRO run checkpoints its sharded opt_state and resumes bit-faithful:
+    Orbax saves the sharded arrays, maybe_resume restores replicated, and the
+    driver re-shards after resume (train() ordering) — end to end through the
+    real driver."""
+    from moco_tpu.train import train
+
+    base = get_preset("cifar10-moco-v1").replace(
+        arch="resnet_tiny", dataset="synthetic", image_size=16, batch_size=32,
+        num_negatives=64, embed_dim=16, epochs=2, steps_per_epoch=4,
+        zero_sharding=True, knn_monitor=False, print_freq=100,
+        ckpt_dir=str(tmp_path / "ckpt"),
+    )
+    state_a, _ = train(base.replace(ckpt_dir=""), mesh8)           # 8 steps straight
+    state_mid, _ = train(base, mesh8, max_steps=4)                  # epoch 1 + save
+    assert int(state_mid.step) == 4
+    import os
+
+    # the save really happened — otherwise run 3 retrains from scratch and
+    # the roundtrip assertions pass vacuously
+    assert sorted(int(d) for d in os.listdir(tmp_path / "ckpt")) == [4]
+    state_b, _ = train(base.replace(resume="auto"), mesh8)          # resume to 8
+
+    assert int(state_a.step) == int(state_b.step) == 8
+    for a, b in zip(jax.tree.leaves(state_a.params_q),
+                    jax.tree.leaves(state_b.params_q), strict=True):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    # the resumed run's opt state is back in the ZeRO placement
+    sharded = [l for l in jax.tree.leaves(state_b.opt_state)
+               if hasattr(l, "sharding") and l.sharding.spec != P()]
+    assert sharded, "resume dropped the ZeRO placement"
