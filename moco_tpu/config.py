@@ -40,6 +40,11 @@ class PretrainConfig:
                                       # the data axis (HBM/N footprint, one
                                       # all-gather of updates per step;
                                       # identical numerics — parallel/zero)
+    grad_allreduce_dtype: str = "float32"  # "bfloat16" halves the grad
+                                      # all-reduce's ICI bytes (quantized
+                                      # collective, EQuARX-style; the master
+                                      # update still runs in f32). Off by
+                                      # default — the reference reduces f32
     fused_bn_conv: bool = True        # Bottleneck bn2→relu→conv3 through the
                                       # Pallas fused kernel on TPU (identical
                                       # params and math; models/fused_block)

@@ -48,6 +48,24 @@ from moco_tpu.parallel.mesh import DATA_AXIS
 from moco_tpu.train_state import TrainState
 
 
+def _pmean_grads(grads, allreduce_dtype: str):
+    """Gradient all-reduce over the data axis, optionally in bfloat16.
+
+    `"bfloat16"` casts each gradient leaf down before the `pmean` and back
+    up after — half the ICI bytes per step (the quantized-collective idea of
+    EQuARX/DynamiQ, PAPERS.md, in its simplest lossy form). The optimizer
+    math stays f32 on the master params; the quantization error (~2^-8
+    relative per leaf) is the same order as bf16 compute noise. Default off:
+    the reference's DDP reduces f32 gradients."""
+    if allreduce_dtype == "float32":
+        return lax.pmean(grads, DATA_AXIS)
+    if allreduce_dtype != "bfloat16":
+        raise ValueError(f"unknown grad_allreduce_dtype {allreduce_dtype!r}")
+    down = jax.tree.map(lambda g: g.astype(jnp.bfloat16), grads)
+    reduced = lax.pmean(down, DATA_AXIS)
+    return jax.tree.map(lambda g: g.astype(jnp.float32), reduced)
+
+
 def build_encoder(config: PretrainConfig):
     """Encoder factory — the reference's `models.__dict__[arch](num_classes=dim)`
     plus the v2 MLP-head splice (`moco/builder.py:≈L25-35`). For v3 the
@@ -239,7 +257,7 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
             loss_fn, has_aux=True
         )(params_q)
         # DDP-equivalent gradient all-reduce (mean over the data axis)
-        grads = lax.pmean(grads, DATA_AXIS)
+        grads = _pmean_grads(grads, config.grad_allreduce_dtype)
         # Running BN stats: averaged across devices so replicas stay
         # bit-identical (replaces DDP broadcast_buffers, SURVEY §2.2 note).
         new_stats_q = lax.pmean(new_stats_q, DATA_AXIS)

@@ -104,7 +104,7 @@ def build_v3_train_step(
     config: PretrainConfig, model: V3Model, tx, mesh, steps_per_epoch: int, sched=None
 ):
     """Jitted `(state, x1, x2) -> (state', metrics)`, state donated."""
-    from moco_tpu.train_step import lr_schedule
+    from moco_tpu.train_step import _pmean_grads, lr_schedule
 
     temperature = config.temperature
     total_steps = config.epochs * steps_per_epoch
@@ -139,7 +139,7 @@ def build_v3_train_step(
         (loss, (new_stats_q, q1)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params_q)
-        grads = lax.pmean(grads, DATA_AXIS)
+        grads = _pmean_grads(grads, config.grad_allreduce_dtype)
         new_stats_q = lax.pmean(new_stats_q, DATA_AXIS)
         new_stats_k = lax.pmean(stats_k, DATA_AXIS)
         # monitoring: in-batch top-1 for the q1·k2 direction
