@@ -246,4 +246,7 @@ def test_full_benchmark_step_lowers_for_tpu():
         exp = jax.export.export(fused, platforms=["tpu"])(
             state, imgs, ext, jax.ShapeDtypeStruct((), jnp.int32)
         )
-        assert exp.mlir_module().count("tpu_custom_call") >= 3
+        # 33 = blur stencils + BN stat/grad reductions + 16 fused bottleneck
+        # tails; a drop means some kernel gate silently fell back to jnp and
+        # the measured perf lever quietly disappeared from the benchmark
+        assert exp.mlir_module().count("tpu_custom_call") >= 33
