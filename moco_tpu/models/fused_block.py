@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from moco_tpu.models.fast_bn import _batch_stats, _normalize, _use_pallas
-from moco_tpu.ops.pallas_fused_conv import bn_relu_matmul
+from moco_tpu.ops.pallas_fused_conv import bn_relu_matmul, bn_relu_matmul_dw
 from moco_tpu.ops.pallas_stats import channel_grad_sums
 
 
@@ -88,12 +88,19 @@ def _bwd(eps, dtype, res, cts):
     rstd = jax.lax.rsqrt(var + eps)  # f32
     a = (scale * rstd).astype(jnp.float32)
     shift = (bias - mean * a).astype(jnp.float32)
-    # recompute ẑ in the dW operand (streams x once; never stored)
-    zpre = xr.astype(jnp.float32) * a + shift
-    z = jnp.maximum(zpre, 0.0).astype(dtype)
-    dw = jnp.einsum(
-        "mk,mn->kn", z, dyr, preferred_element_type=jnp.float32
-    ).reshape(w4d.shape).astype(w4d.dtype)
+    if _use_pallas():
+        # ẑ recomputed inside the Pallas dW kernel's VMEM tiles — x streams
+        # once, the normalized activation never exists in HBM in the
+        # backward either (no bet on XLA operand fusion)
+        dw = bn_relu_matmul_dw(xr, a, shift, dyr).reshape(
+            w4d.shape).astype(w4d.dtype)
+        zpre = xr.astype(jnp.float32) * a + shift  # XLA fuses into the mask
+    else:
+        zpre = xr.astype(jnp.float32) * a + shift
+        z = jnp.maximum(zpre, 0.0).astype(dtype)
+        dw = jnp.einsum(
+            "mk,mn->kn", z, dyr, preferred_element_type=jnp.float32
+        ).reshape(w4d.shape).astype(w4d.dtype)
     # gradient at the normalize output, ReLU-masked
     g = jnp.einsum(
         "mn,kn->mk", dyr, w4d.reshape(k, n).astype(dyr.dtype),

@@ -58,6 +58,61 @@ def _pick_tile(n: int, candidates) -> int:
     return n
 
 
+def _dw_kernel(x_ref, a_ref, b_ref, dy_ref, o_ref, acc_ref):
+    m = pl.program_id(2)
+
+    @pl.when(m == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...].astype(jnp.float32)
+    z = jnp.maximum(x * a_ref[...] + b_ref[...], 0.0).astype(dy_ref.dtype)
+    # contract over the row (m) axis: zᵀ·dy without materializing z
+    acc_ref[...] += jax.lax.dot_general(
+        z, dy_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(m == pl.num_programs(2) - 1)
+    def _():
+        o_ref[...] = acc_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def bn_relu_matmul_dw(
+    x: jax.Array,      # [M, K] activations (pre-normalize)
+    a: jax.Array,      # [K] f32
+    b: jax.Array,      # [K] f32
+    dy: jax.Array,     # [M, N] upstream cotangent
+    interpret: bool = False,
+) -> jax.Array:
+    """dW[K, N] = relu(x·a + b)ᵀ @ dy with ẑ recomputed in VMEM — the
+    backward twin of `bn_relu_matmul` (one streaming read of x and dy; the
+    normalized activation never exists in HBM in either pass)."""
+    m, k = x.shape
+    m2, n = dy.shape
+    assert m == m2, (x.shape, dy.shape)
+    bm = _pick_tile(m, (512, 256, 128, 64, 32, 16, 8))
+    bn = _pick_tile(n, (256, 128, 64, 32, 16, 8))
+    bk = _pick_tile(k, (512, 256, 128, 64, 32, 16, 8))
+    vma = getattr(getattr(x, "aval", None), "vma", frozenset())
+    return pl.pallas_call(
+        _dw_kernel,
+        grid=(k // bk, n // bn, m // bm),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda kk, j, i: (i, kk)),
+            pl.BlockSpec((1, bk), lambda kk, j, i: (0, kk)),
+            pl.BlockSpec((1, bk), lambda kk, j, i: (0, kk)),
+            pl.BlockSpec((bm, bn), lambda kk, j, i: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((bk, bn), lambda kk, j, i: (kk, j)),
+        out_shape=jax.ShapeDtypeStruct((k, n), jnp.float32, vma=vma),
+        scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
+        interpret=interpret,
+    )(x, a.reshape(1, k).astype(jnp.float32),
+      b.reshape(1, k).astype(jnp.float32), dy)
+
+
 @functools.partial(
     jax.jit, static_argnames=("out_dtype", "interpret")
 )

@@ -246,7 +246,38 @@ def test_full_benchmark_step_lowers_for_tpu():
         exp = jax.export.export(fused, platforms=["tpu"])(
             state, imgs, ext, jax.ShapeDtypeStruct((), jnp.int32)
         )
-        # 33 = blur stencils + BN stat/grad reductions + 16 fused bottleneck
-        # tails; a drop means some kernel gate silently fell back to jnp and
-        # the measured perf lever quietly disappeared from the benchmark
-        assert exp.mlir_module().count("tpu_custom_call") >= 33
+        # 37 = blur stencils + BN stat/grad reductions + the fused bottleneck
+        # tails (fwd) + their Pallas dW backward kernels; a drop means some
+        # kernel gate silently fell back to jnp and a measured perf lever
+        # quietly disappeared from the benchmark
+        assert exp.mlir_module().count("tpu_custom_call") >= 37
+
+
+def test_dw_kernel_matches_reference_interpret():
+    """The backward twin: dW = relu(x·a+b)ᵀ @ dy, ẑ recomputed in VMEM."""
+    from moco_tpu.ops.pallas_fused_conv import bn_relu_matmul_dw
+
+    x = jax.random.normal(jax.random.key(20), (256, 64), jnp.float32)
+    a = 1.0 + 0.1 * jax.random.normal(jax.random.key(21), (64,))
+    b = 0.1 * jax.random.normal(jax.random.key(22), (64,))
+    dy = jax.random.normal(jax.random.key(23), (256, 128), jnp.float32)
+    got = bn_relu_matmul_dw(x, a, b, dy, interpret=True)
+    z = jnp.maximum(x * a + b, 0.0)
+    want = z.T @ dy
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_dw_kernel_lowers_for_tpu_at_r50_shapes():
+    from moco_tpu.ops.pallas_fused_conv import bn_relu_matmul_dw
+
+    for m, k, n in [(128 * 56 * 56, 64, 256), (128 * 7 * 7, 512, 2048)]:
+        x = jax.ShapeDtypeStruct((m, k), jnp.bfloat16)
+        a = jax.ShapeDtypeStruct((k,), jnp.float32)
+        b = jax.ShapeDtypeStruct((k,), jnp.float32)
+        dy = jax.ShapeDtypeStruct((m, n), jnp.bfloat16)
+        exp = jax.export.export(
+            jax.jit(lambda x, a, b, dy: bn_relu_matmul_dw(x, a, b, dy)),
+            platforms=["tpu"],
+        )(x, a, b, dy)
+        assert "tpu_custom_call" in exp.mlir_module(), (m, k, n)
