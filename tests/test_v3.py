@@ -205,3 +205,43 @@ def test_v3_r50_lars_step_on_mesh(mesh8):
     # the scale of the two updates must differ materially, not just noise
     ratio = np.linalg.norm(d_lars) / max(np.linalg.norm(d_sgd), 1e-12)
     assert ratio < 0.5 or ratio > 2.0, ratio
+
+
+@pytest.mark.slow
+def test_v3_vits_full_step_lowers_for_tpu():
+    """Config 5's whole benchmark program (asymmetric v3 aug pair with the
+    Pallas blur, ViT-S with remat, symmetric loss, AdamW) exports for the
+    TPU platform from CPU — hardware-free lowering assurance like the v2
+    pin in test_fused_conv."""
+    import unittest.mock as mock
+
+    import moco_tpu.models.fast_bn as fbn
+    from moco_tpu.config import get_preset
+    from moco_tpu.data.augment import build_two_crops_sharded, v3_aug_configs, with_dtype
+    from moco_tpu.parallel.mesh import create_mesh
+    from moco_tpu.train_step import (
+        build_encoder, build_fused_step, build_optimizer, build_train_step,
+    )
+    from moco_tpu.v3_step import create_v3_train_state
+
+    Bv = 256
+    config = get_preset("imagenet-moco-v3-vits").replace(batch_size=Bv, remat=True)
+    mesh = create_mesh(1)
+    with mock.patch.object(jax, "default_backend", lambda: "tpu"), \
+         mock.patch.object(fbn, "_use_pallas", lambda: True):
+        model = build_encoder(config)
+        tx, sched = build_optimizer(config, 1000)
+        state = jax.eval_shape(lambda: create_v3_train_state(
+            jax.random.key(0), model, tx, (Bv, 224, 224, 3)))
+        step_fn = build_train_step(config, model, tx, mesh, 1000, sched)
+        two = build_two_crops_sharded(
+            with_dtype(v3_aug_configs(224), "bfloat16"), mesh
+        )
+        fused = build_fused_step(step_fn, two, jax.random.key(1))
+        imgs = jax.ShapeDtypeStruct((Bv, 252, 252, 3), jnp.uint8)
+        ext = jax.ShapeDtypeStruct((Bv, 3), jnp.int32)
+        exp = jax.export.export(fused, platforms=["tpu"])(
+            state, imgs, ext, jax.ShapeDtypeStruct((), jnp.int32)
+        )
+        # the Pallas blur is the one custom kernel on the ViT path
+        assert exp.mlir_module().count("tpu_custom_call") >= 1
