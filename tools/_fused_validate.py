@@ -29,6 +29,16 @@ err = np.abs(got - want) / (np.abs(want) + 1.0)
 print(f"kernel rel err: mean {err.mean():.2e} max {err.max():.2e}")
 assert err.max() < 0.05, "fused kernel numerics off on TPU"
 
+# --- 1b) dW backward kernel numerics ---
+from moco_tpu.ops.pallas_fused_conv import bn_relu_matmul_dw
+
+dy = jax.random.normal(jax.random.key(4), (m, n)).astype(jnp.bfloat16)
+dw_got = np.asarray(bn_relu_matmul_dw(x, a, b, dy), np.float32)
+dw_want = z.astype(np.float32).T @ np.asarray(dy, np.float32)
+dw_err = np.abs(dw_got - dw_want) / (np.abs(dw_want) + 1.0)
+print(f"dW kernel rel err: mean {dw_err.mean():.2e} max {dw_err.max():.2e}")
+assert dw_err.max() < 0.05, "dW kernel numerics off on TPU"
+
 # --- 2) block equivalence on TPU ---
 from functools import partial
 import flax.linen as nn
