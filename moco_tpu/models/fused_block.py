@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from moco_tpu.models.fast_bn import _batch_stats, _normalize, _use_pallas
 from moco_tpu.ops.pallas_fused_conv import bn_relu_matmul, bn_relu_matmul_dw
+from moco_tpu.ops.pallas_fused_conv3x3 import bn_relu_conv3x3
 from moco_tpu.ops.pallas_stats import channel_grad_sums
 
 
@@ -132,53 +133,145 @@ def _bwd(eps, dtype, res, cts):
 _bn_relu_conv_train.defvjp(_fwd, _bwd)
 
 
-def fused_bn_relu_conv3(
+def _conv3x3(z, w4d, dtype):
+    return jax.lax.conv_general_dilated(
+        z, w4d.astype(dtype), (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _plain_apply3x3(x, mean, var, scale, bias, w4d, eps, dtype):
+    z = nn.relu(_normalize(x, mean, var, scale, bias, eps, dtype))
+    return _conv3x3(z, w4d, dtype)
+
+
+def _train3x3_impl(x, scale, bias, w4d, eps, dtype):
+    mean, var = _batch_stats(x, _use_pallas())
+    if _use_pallas():
+        rstd = jax.lax.rsqrt(var + eps)
+        a = scale * rstd
+        y = bn_relu_conv3x3(x, a, bias - mean * a, w4d, out_dtype=dtype)
+    else:
+        y = _plain_apply3x3(x, mean, var, scale, bias, w4d, eps, dtype)
+    return y, mean, var
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _bn_relu_conv3x3_train(x, scale, bias, w4d, eps, dtype):
+    return _train3x3_impl(x, scale, bias, w4d, eps, dtype)
+
+
+def _fwd3x3(x, scale, bias, w4d, eps, dtype):
+    y, mean, var = _train3x3_impl(x, scale, bias, w4d, eps, dtype)
+    return (y, mean, var), (x, mean, var, scale, bias, w4d)
+
+
+def _bwd3x3(eps, dtype, res, cts):
+    x, mean, var, scale, bias, w4d = res
+    dy, _dmean, _dvar = cts
+    k = x.shape[-1]
+    rstd = jax.lax.rsqrt(var + eps)
+    a = (scale * rstd).astype(jnp.float32)
+    shift = (bias - mean * a).astype(jnp.float32)
+    zpre = x.astype(jnp.float32) * a + shift
+    z = jnp.maximum(zpre, 0.0).astype(dtype)
+    # exact conv backprops (filter-grad and input-grad convs) via jax.vjp on
+    # the reference conv — XLA emits the standard transposed convolutions
+    _, conv_vjp = jax.vjp(lambda z_, w_: _conv3x3(z_, w_, dtype), z, w4d)
+    dz, dw = conv_vjp(dy)
+    g = dz.astype(jnp.float32) * (zpre > 0)
+    if _use_pallas():
+        dsum, dxh = channel_grad_sums(g, x, mean, rstd)
+    else:
+        gf = g.reshape(-1, k)
+        xh = (x.reshape(-1, k).astype(jnp.float32) - mean) * rstd
+        dsum = jnp.sum(gf, axis=0)
+        dxh = jnp.sum(gf * xh, axis=0)
+    nelem = x.size // k
+    xh_full = (x.astype(jnp.float32) - mean) * rstd
+    dx = (scale * rstd) * (g - (xh_full * (dxh / nelem) + dsum / nelem))
+    return (
+        dx.astype(x.dtype),
+        dxh.astype(scale.dtype),
+        dsum.astype(bias.dtype),
+        dw.astype(w4d.dtype),
+    )
+
+
+_bn_relu_conv3x3_train.defvjp(_fwd3x3, _bwd3x3)
+
+
+def _fused_bn_relu_conv(
     mdl: nn.Module,
     x: jax.Array,
-    features: int,
+    bn_name: str,
+    conv_name: str,
+    kshape: tuple,
     train: bool,
     momentum: float,
     eps: float,
     dtype,
+    plain_fn,
+    train_fn,
 ) -> jax.Array:
-    """Declare bn2+conv3 params/stats under `mdl`'s scope (names identical
-    to the unfused `nn.BatchNorm(name="bn2")` + `nn.Conv(name="conv3")`) and
-    apply the fused tail."""
+    """Shared scaffolding for both fusions: declare bn+conv params/stats
+    under `mdl`'s scope with the UNFUSED module names (checkpoint/export
+    byte-compatible), gate eval/init onto `plain_fn` (running stats), and
+    run `train_fn` (the custom-VJP fused path) with the flax running-stat
+    update."""
     k = x.shape[-1]
     bn = mdl.param(
-        "bn2",
+        bn_name,
         lambda rng: {
             "scale": jnp.ones((k,), jnp.float32),
             "bias": jnp.zeros((k,), jnp.float32),
         },
     )
     w4d = mdl.param(
-        "conv3",
+        conv_name,
         lambda rng: {
-            "kernel": nn.initializers.lecun_normal()(
-                rng, (1, 1, k, features), jnp.float32
-            )
+            "kernel": nn.initializers.lecun_normal()(rng, kshape, jnp.float32)
         },
     )["kernel"]
     ra = mdl.variable(
         "batch_stats",
-        "bn2",
+        bn_name,
         lambda: {
             "mean": jnp.zeros((k,), jnp.float32),
             "var": jnp.ones((k,), jnp.float32),
         },
     )
     if not train or mdl.is_initializing():
-        y = _plain_apply(
+        return plain_fn(
             x, ra.value["mean"], ra.value["var"], bn["scale"], bn["bias"],
             w4d, eps, dtype,
         )
-        return y
-    y, mean, var = _bn_relu_conv_train(
-        x, bn["scale"], bn["bias"], w4d, eps, dtype
-    )
+    y, mean, var = train_fn(x, bn["scale"], bn["bias"], w4d, eps, dtype)
     ra.value = {
         "mean": momentum * ra.value["mean"] + (1 - momentum) * mean,
         "var": momentum * ra.value["var"] + (1 - momentum) * var,
     }
     return y
+
+
+def fused_bn_relu_conv2(
+    mdl: nn.Module, x, features: int, train: bool, momentum: float,
+    eps: float, dtype,
+) -> jax.Array:
+    """The Bottleneck's bn1→relu→conv2 (3x3, stride-1) interior fusion;
+    stride-2 stage-first blocks keep the unfused path (caller gates)."""
+    return _fused_bn_relu_conv(
+        mdl, x, "bn1", "conv2", (3, 3, x.shape[-1], features), train,
+        momentum, eps, dtype, _plain_apply3x3, _bn_relu_conv3x3_train,
+    )
+
+
+def fused_bn_relu_conv3(
+    mdl: nn.Module, x, features: int, train: bool, momentum: float,
+    eps: float, dtype,
+) -> jax.Array:
+    """The Bottleneck's bn2→relu→conv3 (1x1) tail fusion."""
+    return _fused_bn_relu_conv(
+        mdl, x, "bn2", "conv3", (1, 1, x.shape[-1], features), train,
+        momentum, eps, dtype, _plain_apply, _bn_relu_conv_train,
+    )

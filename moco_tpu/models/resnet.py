@@ -114,10 +114,12 @@ class BasicBlock(nn.Module):
 class Bottleneck(nn.Module):
     """1x1 → 3x3(stride) → 1x1(x4) residual block (ResNet-50/101/152, v1.5).
 
-    `fused_tail=True` computes the bn2→relu→conv3 tail through the Pallas
-    fused kernel (models/fused_block.py): identical params/names/math, the
-    normalized activation never materializes in HBM. Engages the kernel on
-    TPU only; incompatible with SyncBN (callers gate on that)."""
+    `fused_tail=True` computes BOTH interior normalize passes through Pallas
+    fused kernels (models/fused_block.py): bn1→relu→conv2 (3x3, stride-1
+    blocks) and bn2→relu→conv3 (1x1, all blocks) — identical
+    params/names/math, the normalized activations never materialize in HBM.
+    Engages the kernels on TPU only; incompatible with SyncBN (callers gate
+    on that)."""
 
     filters: int
     strides: int = 1
@@ -132,20 +134,32 @@ class Bottleneck(nn.Module):
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (1, 1), name="conv1")(x)
-        y = self.norm(name="bn1")(y)
-        y = nn.relu(y)
-        # explicit pad 1: torchvision-symmetric (see BasicBlock note)
-        y = self.conv(
-            self.filters, (3, 3), (self.strides, self.strides),
-            padding=[(1, 1), (1, 1)], name="conv2",
-        )(y)
         if self.fused_tail:
-            from moco_tpu.models.fused_block import fused_bn_relu_conv3
+            from moco_tpu.models.fused_block import (
+                fused_bn_relu_conv2,
+                fused_bn_relu_conv3,
+            )
 
             # train flag: the norm partial carries use_running_average=not train
             train = not getattr(self.norm, "keywords", {}).get(
                 "use_running_average", False
             )
+        if self.fused_tail and self.strides == 1:
+            # interior fusion #2: bn1→relu→conv2 through the Pallas 3x3
+            # kernel (stride-2 stage-first blocks keep the unfused path)
+            y = fused_bn_relu_conv2(
+                self, y, self.filters, train, self.bn_momentum, 1e-5,
+                self.dtype,
+            )
+        else:
+            y = self.norm(name="bn1")(y)
+            y = nn.relu(y)
+            # explicit pad 1: torchvision-symmetric (see BasicBlock note)
+            y = self.conv(
+                self.filters, (3, 3), (self.strides, self.strides),
+                padding=[(1, 1), (1, 1)], name="conv2",
+            )(y)
+        if self.fused_tail:
             y = fused_bn_relu_conv3(
                 self, y, self.filters * self.expansion, train,
                 self.bn_momentum, 1e-5, self.dtype,

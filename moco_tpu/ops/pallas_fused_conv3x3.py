@@ -1,0 +1,170 @@
+"""Pallas TPU kernel: BN-normalize → ReLU fused into a stride-1 3x3 conv.
+
+Companion to `pallas_fused_conv.py` (the 1x1 tail): the Bottleneck's OTHER
+interior normalize pass is bn1→relu feeding the 3x3 conv2. A 3x3 stride-1
+convolution is nine channel-contractions over row/column-shifted views, so
+the same in-register trick applies — normalize+ReLU each x tile in VMEM and
+accumulate the nine `[rows·W, K] @ [K, N]` tap matmuls without the
+normalized tensor ever reaching HBM.
+
+Halo handling: the kernel receives the SAME array through three input refs
+whose index maps point at the previous / current / next row-block (clamped
+at the boundary); row masks zero the out-of-range contributions, and column
+shifts are masked at the W edges, reproducing the conv's zero padding
+exactly.
+
+Stride-2 conv2 (the first block of each stage) keeps the unfused path —
+strided halo tiling buys 4 of 16 blocks and is not worth the index
+complexity. `interpret=True` runs on CPU for the equivalence tests;
+`tests/test_fused_conv3x3.py` also pins the TPU (Mosaic) lowering
+hardware-free via cross-platform export.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _conv3x3_kernel(xm_ref, x0_ref, xp_ref, a_ref, b_ref, w_ref, o_ref, *,
+                    bh, h, blocks_per_img):
+    """One row-block [bh, W, K] → [bh, W, N].
+
+    x0 is the current row-block; xm/xp are SINGLE halo rows (the row just
+    above / below the block, index maps clamped WITHIN the image; masks
+    below zero the clamped rows) — x streams at ~(bh+2)/bh reads, not 3x.
+    The batch is folded into the row grid, so all row coordinates here are
+    per-IMAGE (a block never straddles an image). w_ref holds the taps as
+    [9, K, N].
+    """
+    i = pl.program_id(0)  # row-block index over B*H/bh
+    w_all = w_ref[...]
+    bw = x0_ref.shape[1]  # W (full width in this block)
+    k = x0_ref.shape[2]
+    n = w_all.shape[-1]
+
+    def normalize(ref):
+        x = ref[...].astype(jnp.float32)
+        return jnp.maximum(x * a_ref[0, 0] + b_ref[0, 0], 0.0).astype(w_all.dtype)
+
+    zm = normalize(xm_ref)  # [1, W, K] halo row above (clamped at image top)
+    z0 = normalize(x0_ref)  # [bh, W, K] current row-block
+    zp = normalize(xp_ref)  # [1, W, K] halo row below (clamped at bottom)
+
+    acc = jnp.zeros((bh * bw, n), jnp.float32)
+    col = jax.lax.broadcasted_iota(jnp.int32, (bh, bw, 1), 1)
+    row_in_block = jax.lax.broadcasted_iota(jnp.int32, (bh, bw, 1), 0)
+    # row within THIS IMAGE (zero pad happens at image edges, not batch ones)
+    img_row = (i % blocks_per_img) * bh + row_in_block
+
+    for di in (-1, 0, 1):
+        # source rows (img_row + di): build the di-shifted row view of the
+        # current block from the halo rows + z0
+        if di == 0:
+            z_rows = z0
+            row_ok = jnp.ones((bh, bw, 1), jnp.bool_)
+        elif di == -1:
+            # shift down: row r reads source row r-1 → top row is the halo
+            # (bh == 1: the shifted block IS the halo row; avoids a
+            # zero-size slice, which Mosaic rejects)
+            z_rows = zm if bh == 1 else jnp.concatenate(
+                [zm, z0[:-1]], axis=0
+            )
+            row_ok = img_row - 1 >= 0
+        else:
+            z_rows = zp if bh == 1 else jnp.concatenate(
+                [z0[1:], zp], axis=0
+            )
+            row_ok = img_row + 1 <= h - 1
+        for dj in (-1, 0, 1):
+            if dj == 0:
+                z_tap = z_rows
+                col_ok = jnp.ones((bh, bw, 1), jnp.bool_)
+            elif dj == -1:
+                z_tap = jnp.concatenate(
+                    [jnp.zeros_like(z_rows[:, :1]), z_rows[:, :-1]], axis=1
+                )
+                col_ok = col - 1 >= 0
+            else:
+                z_tap = jnp.concatenate(
+                    [z_rows[:, 1:], jnp.zeros_like(z_rows[:, :1])], axis=1
+                )
+                col_ok = col + 1 <= bw - 1
+            mask = (row_ok & col_ok).astype(w_all.dtype)
+            z_masked = (z_tap * mask).reshape(bh * bw, k)
+            tap = w_all[(di + 1) * 3 + (dj + 1)]
+            acc += jnp.dot(z_masked, tap, preferred_element_type=jnp.float32)
+    o_ref[...] = acc.reshape(bh, bw, n).astype(o_ref.dtype)
+
+
+def _pick_rows(h: int, w: int, k: int) -> int:
+    """Row-block: target a few hundred KB of z tile, divide H."""
+    target = max(1, (256 << 10) // max(1, 2 * w * k))
+    bh = 1
+    for c in (32, 16, 8, 4, 2, 1):
+        if c <= target and h % c == 0:
+            bh = c
+            break
+    return bh
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+def bn_relu_conv3x3(
+    x: jax.Array,      # [B, H, W, K] pre-normalize activations
+    a: jax.Array,      # [K] f32 (γ·rstd)
+    b: jax.Array,      # [K] f32 (β − μ·γ·rstd)
+    w: jax.Array,      # [3, 3, K, N] conv kernel
+    out_dtype=jnp.bfloat16,
+    interpret: bool = False,
+) -> jax.Array:
+    """relu(x·a + b) ⊛ w (stride 1, zero pad 1), normalized tensor VMEM-only.
+
+    The batch folds into the row grid: blocks never straddle a batch
+    boundary (bh divides H), and the row masks use per-image coordinates.
+    """
+    bsz, h, wd, k = x.shape
+    n = w.shape[-1]
+    bh = _pick_rows(h, wd, k)
+    xr = x.reshape(bsz * h, wd, k)
+    w9 = w.reshape(9, k, n).astype(x.dtype)
+    nblocks = (bsz * h) // bh
+    blocks_per_img = h // bh
+
+    # current row-block, plus SINGLE-ROW halo blocks above/below (block
+    # shape (1, W, K) → the row index IS the block index), clamped to the
+    # same image; the kernel's row masks zero the clamped contributions
+    def idx_cur(i):
+        return (i, 0, 0)
+
+    def idx_prev_row(i):
+        img = i // blocks_per_img
+        return (jnp.maximum(i * bh - 1, img * h), 0, 0)
+
+    def idx_next_row(i):
+        img = i // blocks_per_img
+        return (jnp.minimum((i + 1) * bh, (img + 1) * h - 1), 0, 0)
+
+    vma = getattr(getattr(x, "aval", None), "vma", frozenset())
+    kernel = functools.partial(_conv3x3_kernel, bh=bh, h=h,
+                               blocks_per_img=blocks_per_img)
+    out = pl.pallas_call(
+        kernel,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((1, wd, k), idx_prev_row),
+            pl.BlockSpec((bh, wd, k), idx_cur),
+            pl.BlockSpec((1, wd, k), idx_next_row),
+            pl.BlockSpec((1, 1, k), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, 1, k), lambda i: (0, 0, 0)),
+            pl.BlockSpec((9, k, n), lambda i: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bh, wd, n), idx_cur),
+        out_shape=jax.ShapeDtypeStruct((bsz * h, wd, n), out_dtype, vma=vma),
+        interpret=interpret,
+    )(xr, xr, xr, a.reshape(1, 1, k).astype(jnp.float32),
+      b.reshape(1, 1, k).astype(jnp.float32), w9)
+    return out.reshape(bsz, h, wd, n)

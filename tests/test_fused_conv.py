@@ -246,11 +246,21 @@ def test_full_benchmark_step_lowers_for_tpu():
         exp = jax.export.export(fused, platforms=["tpu"])(
             state, imgs, ext, jax.ShapeDtypeStruct((), jnp.int32)
         )
-        # 37 = blur stencils + BN stat/grad reductions + the fused bottleneck
-        # tails (fwd) + their Pallas dW backward kernels; a drop means some
-        # kernel gate silently fell back to jnp and a measured perf lever
+        # per-kernel-name census (post-CSE unique call sites): a drop in any
+        # row means a kernel gate silently fell back to jnp and a perf lever
         # quietly disappeared from the benchmark
-        assert exp.mlir_module().count("tpu_custom_call") >= 37
+        import re
+        from collections import Counter
+
+        mod = exp.mlir_module()
+        names = Counter(re.findall(r'kernel_name = "([^"]+)"', mod))
+        assert names["_blur_kernel"] >= 1, names          # Pallas blur
+        assert names["_sums_kernel"] >= 12, names         # BN fwd stats
+        assert names["_grad_sums_kernel"] >= 12, names    # BN bwd reductions
+        assert names["_kernel"] >= 4, names               # fused conv3 tails
+        assert names["_conv3x3_kernel"] >= 4, names       # fused conv2 mids
+        assert names["_dw_kernel"] >= 4, names            # fused-tail dW bwd
+        assert mod.count("tpu_custom_call") >= 37
 
 
 def test_dw_kernel_matches_reference_interpret():

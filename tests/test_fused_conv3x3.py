@@ -1,0 +1,115 @@
+"""Equivalence pins for the fused bn→relu→3x3-conv (stride 1) interior
+fusion (ops/pallas_fused_conv3x3.py + models/fused_block.py).
+
+Same proof ladder as the 1x1 tail (test_fused_conv.py): interpret-mode
+kernel equivalence (incl. batch boundaries — zero padding must happen at
+IMAGE edges, never leak across the folded batch), custom-VJP vs autodiff,
+and hardware-free TPU (Mosaic) lowering at the real R50 conv2 shapes.
+The module-level integration (param-tree identity, grads, running stats,
+shard_map composition) is covered by test_fused_conv.py's Bottleneck tests,
+which exercise BOTH fusions on stride-1 blocks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from moco_tpu.models.fused_block import _bn_relu_conv3x3_train
+from moco_tpu.ops.pallas_fused_conv3x3 import bn_relu_conv3x3
+
+
+def _ref(x, a, b, w):
+    z = jnp.maximum(x.astype(jnp.float32) * a + b, 0.0)
+    return jax.lax.conv_general_dilated(
+        z, w.astype(jnp.float32), (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+@pytest.mark.parametrize(
+    "shape", [(2, 8, 8, 16, 32), (3, 12, 10, 8, 16), (1, 4, 16, 32, 8)]
+)
+def test_kernel_matches_conv_interpret(shape):
+    bsz, h, w_, k, n = shape
+    x = jax.random.normal(jax.random.key(0), (bsz, h, w_, k), jnp.float32)
+    a = 1.0 + 0.1 * jax.random.normal(jax.random.key(1), (k,))
+    b = 0.1 * jax.random.normal(jax.random.key(2), (k,))
+    w = 0.1 * jax.random.normal(jax.random.key(3), (3, 3, k, n))
+    got = bn_relu_conv3x3(x, a, b, w, out_dtype=jnp.float32, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_ref(x, a, b, w)), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_batch_boundary_no_halo_leak_interpret():
+    """Two images whose edge rows are wildly different: each image's output
+    must equal its own single-image conv — any halo leak across the folded
+    batch dimension shows up immediately."""
+    k, n = 8, 8
+    x0 = jnp.full((1, 4, 4, k), 100.0, jnp.float32)
+    x1 = jnp.full((1, 4, 4, k), -100.0, jnp.float32)
+    a = jnp.ones((k,))
+    b = jnp.zeros((k,))
+    w = 0.1 * jax.random.normal(jax.random.key(4), (3, 3, k, n))
+    both = bn_relu_conv3x3(
+        jnp.concatenate([x0, x1]), a, b, w, out_dtype=jnp.float32,
+        interpret=True,
+    )
+    solo0 = bn_relu_conv3x3(x0, a, b, w, out_dtype=jnp.float32, interpret=True)
+    solo1 = bn_relu_conv3x3(x1, a, b, w, out_dtype=jnp.float32, interpret=True)
+    np.testing.assert_allclose(np.asarray(both[0]), np.asarray(solo0[0]),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(both[1]), np.asarray(solo1[0]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_custom_vjp_matches_autodiff():
+    eps = 1e-5
+    x = jax.random.normal(jax.random.key(6), (2, 6, 6, 16), jnp.float32)
+    scale = 1.0 + 0.1 * jax.random.normal(jax.random.key(7), (16,))
+    bias = 0.1 * jax.random.normal(jax.random.key(8), (16,))
+    w = 0.1 * jax.random.normal(jax.random.key(9), (3, 3, 16, 8))
+
+    def unfused(x, scale, bias, w):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.mean(xf * xf, axis=(0, 1, 2)) - mean * mean
+        z = jnp.maximum(
+            (xf - mean) * (jax.lax.rsqrt(var + eps) * scale) + bias, 0.0
+        )
+        return jax.lax.conv_general_dilated(
+            z, w, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    def loss_fused(args):
+        y, _, _ = _bn_relu_conv3x3_train(*args, eps, jnp.float32)
+        return jnp.sum(y * jnp.sin(y))
+
+    def loss_ref(args):
+        y = unfused(*args)
+        return jnp.sum(y * jnp.sin(y))
+
+    args = (x, scale, bias, w)
+    lf, gf = jax.value_and_grad(loss_fused)(args)
+    lr_, gr = jax.value_and_grad(loss_ref)(args)
+    np.testing.assert_allclose(float(lf), float(lr_), rtol=1e-5)
+    for a, b_ in zip(jax.tree.leaves(gf), jax.tree.leaves(gr), strict=True):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=3e-4, atol=3e-4
+        )
+
+
+def test_kernel_lowers_for_tpu_at_r50_shapes():
+    for (bsz, h, w_, k) in [
+        (128, 56, 56, 64), (128, 28, 28, 128),
+        (128, 14, 14, 256), (128, 7, 7, 512),
+    ]:
+        x = jax.ShapeDtypeStruct((bsz, h, w_, k), jnp.bfloat16)
+        a = jax.ShapeDtypeStruct((k,), jnp.float32)
+        b = jax.ShapeDtypeStruct((k,), jnp.float32)
+        w = jax.ShapeDtypeStruct((3, 3, k, k), jnp.bfloat16)
+        fn = lambda x, a, b, w: bn_relu_conv3x3(x, a, b, w, out_dtype=jnp.bfloat16)
+        exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(x, a, b, w)
+        assert "tpu_custom_call" in exp.mlir_module(), (bsz, h, w_, k)
