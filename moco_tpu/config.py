@@ -45,9 +45,12 @@ class PretrainConfig:
                                       # collective, EQuARX-style; the master
                                       # update still runs in f32). Off by
                                       # default — the reference reduces f32
-    fused_bn_conv: bool = True        # Bottleneck bn2→relu→conv3 through the
-                                      # Pallas fused kernel on TPU (identical
-                                      # params and math; models/fused_block)
+    fused_bn_conv: bool = True        # interior bn→relu→conv passes through
+                                      # Pallas fused kernels on TPU: the
+                                      # Bottleneck 1x1 tail + stride-1 3x3
+                                      # mids, and BasicBlock's conv2
+                                      # (identical params and math;
+                                      # models/fused_block)
     # data
     dataset: str = "synthetic"        # synthetic | cifar10 | imagefolder
     data_dir: str = ""

@@ -36,6 +36,14 @@ from moco_tpu.ops.pallas_fused_conv3x3 import bn_relu_conv3x3
 from moco_tpu.ops.pallas_stats import channel_grad_sums
 
 
+def norm_train_flag(norm) -> bool:
+    """Train-mode sniff shared by the fused blocks: the ResNet passes its
+    norm as a `functools.partial` carrying `use_running_average=not train`.
+    A bare module class (no `keywords`) yields train=True, matching
+    `nn.BatchNorm`'s own `use_running_average=False` default."""
+    return not getattr(norm, "keywords", {}).get("use_running_average", False)
+
+
 def _plain_apply(x, mean, var, scale, bias, w4d, eps, dtype):
     """The unfused math in flax's exact op order: f32 normalize cast to
     `dtype`, ReLU, then the 1x1 conv as `lax.conv` in `dtype` (what
@@ -258,8 +266,9 @@ def fused_bn_relu_conv2(
     mdl: nn.Module, x, features: int, train: bool, momentum: float,
     eps: float, dtype,
 ) -> jax.Array:
-    """The Bottleneck's bn1→relu→conv2 (3x3, stride-1) interior fusion;
-    stride-2 stage-first blocks keep the unfused path (caller gates)."""
+    """The bn1→relu→conv2 (3x3, stride-1) interior fusion — Bottleneck mids
+    and BasicBlock tails; stride-2 sites keep the unfused path (callers
+    gate)."""
     return _fused_bn_relu_conv(
         mdl, x, "bn1", "conv2", (3, 3, x.shape[-1], features), train,
         momentum, eps, dtype, _plain_apply3x3, _bn_relu_conv3x3_train,

@@ -81,12 +81,20 @@ def _space_to_depth_stem(x, kernel, dtype):
 
 
 class BasicBlock(nn.Module):
-    """2x3x3 residual block (ResNet-18/34)."""
+    """2x3x3 residual block (ResNet-18/34).
+
+    `fused_tail=True` runs the interior bn1→relu→conv2 pass (conv2 is
+    ALWAYS stride 1 here) through the Pallas 3x3 fused kernel
+    (models/fused_block.py) — same params/names/math as the unfused
+    modules."""
 
     filters: int
     strides: int = 1
     conv: ModuleDef = nn.Conv
     norm: ModuleDef = nn.BatchNorm
+    fused_tail: bool = False
+    bn_momentum: float = 0.9
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
@@ -99,9 +107,22 @@ class BasicBlock(nn.Module):
             self.filters, (3, 3), (self.strides, self.strides),
             padding=[(1, 1), (1, 1)], name="conv1",
         )(x)
-        y = self.norm(name="bn1")(y)
-        y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)], name="conv2")(y)
+        if self.fused_tail:
+            from moco_tpu.models.fused_block import (
+                fused_bn_relu_conv2,
+                norm_train_flag,
+            )
+
+            y = fused_bn_relu_conv2(
+                self, y, self.filters, norm_train_flag(self.norm),
+                self.bn_momentum, 1e-5, self.dtype,
+            )
+        else:
+            y = self.norm(name="bn1")(y)
+            y = nn.relu(y)
+            y = self.conv(
+                self.filters, (3, 3), padding=[(1, 1), (1, 1)], name="conv2"
+            )(y)
         y = self.norm(name="bn2")(y)
         if residual.shape != y.shape:
             residual = self.conv(
@@ -138,12 +159,10 @@ class Bottleneck(nn.Module):
             from moco_tpu.models.fused_block import (
                 fused_bn_relu_conv2,
                 fused_bn_relu_conv3,
+                norm_train_flag,
             )
 
-            # train flag: the norm partial carries use_running_average=not train
-            train = not getattr(self.norm, "keywords", {}).get(
-                "use_running_average", False
-            )
+            train = norm_train_flag(self.norm)
         if self.fused_tail and self.strides == 1:
             # interior fusion #2: bn1→relu→conv2 through the Pallas 3x3
             # kernel (stride-2 stage-first blocks keep the unfused path)
@@ -210,9 +229,11 @@ class ResNet(nn.Module):
                            # backward — trades (underutilized) MXU FLOPs for
                            # HBM traffic on the memory-bound step. Identical
                            # numerics (same ops, re-executed).
-    fused_bn_conv: bool = False  # Bottleneck bn2→relu→conv3 via the Pallas
-                                 # fused kernel (same params; TPU-only
-                                 # engagement; ignored for BasicBlock/SyncBN)
+    fused_bn_conv: bool = False  # interior bn→relu→conv passes through the
+                                 # Pallas fused kernels: Bottleneck conv3
+                                 # tail + stride-1 conv2 mids, BasicBlock
+                                 # conv2 (same params; TPU-only engagement;
+                                 # ignored for SyncBN)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -265,7 +286,7 @@ class ResNet(nn.Module):
         block_kwargs = {}
         if (
             self.fused_bn_conv
-            and self.block_cls is Bottleneck
+            and self.block_cls in (Bottleneck, BasicBlock)
             and self.bn_cross_replica_axis is None
             # engage on TPU only: the CPU fallback inside the fused tail is
             # mathematically equal but uses the closed-form BN backward,

@@ -113,3 +113,56 @@ def test_kernel_lowers_for_tpu_at_r50_shapes():
         fn = lambda x, a, b, w: bn_relu_conv3x3(x, a, b, w, out_dtype=jnp.bfloat16)
         exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(x, a, b, w)
         assert "tpu_custom_call" in exp.mlir_module(), (bsz, h, w_, k)
+
+
+@pytest.mark.parametrize("train", [True, False])
+def test_basicblock_fused_equivalent(train):
+    """BasicBlock's bn1→relu→conv2 fusion (R18/34 path): identical
+    param/stat tree, matching outputs/grads/running stats vs unfused."""
+    from functools import partial
+
+    import flax.linen as nn
+
+    from moco_tpu.models.resnet import BasicBlock
+
+    conv = partial(nn.Conv, use_bias=False, dtype=jnp.float32,
+                   param_dtype=jnp.float32)
+    norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                   epsilon=1e-5, dtype=jnp.float32, param_dtype=jnp.float32)
+    kw = dict(filters=16, strides=1, conv=conv, norm=norm)
+    plain = BasicBlock(**kw)
+    fused = BasicBlock(fused_tail=True, bn_momentum=0.9, dtype=jnp.float32, **kw)
+    x = jax.random.normal(jax.random.key(30), (2, 8, 8, 16), jnp.float32)
+    v = plain.init(jax.random.key(31), x)
+    v2 = fused.init(jax.random.key(31), x)
+    assert jax.tree.structure(v) == jax.tree.structure(v2)
+
+    if train:
+        out_a, mut_a = plain.apply(v, x, mutable=["batch_stats"])
+        out_b, mut_b = fused.apply(v, x, mutable=["batch_stats"])
+        for a, b_ in zip(jax.tree.leaves(mut_a), jax.tree.leaves(mut_b),
+                         strict=True):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-5, atol=1e-6)
+
+        def loss(params, model):
+            out, _ = model.apply(
+                {"params": params, "batch_stats": v["batch_stats"]},
+                x, mutable=["batch_stats"],
+            )
+            return jnp.sum(out ** 2)
+
+        ga = jax.grad(loss)(v["params"], plain)
+        gb = jax.grad(loss)(v["params"], fused)
+        for (pa, a), (_, b_) in zip(
+            jax.tree_util.tree_leaves_with_path(ga),
+            jax.tree_util.tree_leaves_with_path(gb),
+            strict=True,
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=3e-4, atol=3e-4, err_msg=str(pa))
+    else:
+        out_a = plain.apply(v, x)
+        out_b = fused.apply(v, x)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                               rtol=1e-5, atol=1e-5)
