@@ -1,0 +1,83 @@
+"""bench.py orchestrator logic (VERDICT r2 #1): retry env plumbing, JSON
+extraction, degradation record, and the always-one-JSON-line guarantee —
+unit-tested with a stubbed child so no backend (or 25-minute timeout) is
+involved. The live paths are exercised against the real dead/alive backend
+separately (BENCH artifacts)."""
+
+import json
+import sys
+import unittest.mock as mock
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+import bench
+
+
+def _parse_only_line(capsys):
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, out
+    return json.loads(out[0])
+
+
+def test_orchestrate_passes_through_first_success(capsys):
+    ok = {"metric": "moco_v2_r50_pretrain_throughput_per_chip",
+          "value": 2000.0, "unit": "imgs/sec/chip", "vs_baseline": 11.9}
+    with mock.patch.object(bench, "_run_child", return_value=(ok, None)) as rc:
+        bench.orchestrate("step")
+    rec = _parse_only_line(capsys)
+    assert rec == ok  # no degraded_from on a clean first attempt
+    (mode, timeout, env), _ = rc.call_args
+    assert mode == "step" and "MOCO_TPU_DISABLE_FUSED" not in env
+
+
+def test_orchestrate_retry_disables_fused_then_degrades(capsys):
+    calls = []
+
+    def fake(mode, timeout_s, env):
+        calls.append(dict(env))
+        if len(calls) < 3:
+            return None, f"rc=1: boom{len(calls)}"
+        return ({"metric": "moco_v2_tiny_cpu_proxy_throughput_per_chip",
+                 "value": 350.0, "unit": "imgs/sec/chip",
+                 "vs_baseline": 2.08}, None)
+
+    with mock.patch.object(bench, "_run_child", side_effect=fake), \
+         mock.patch.object(bench.time, "sleep"):
+        bench.orchestrate("step")
+    rec = _parse_only_line(capsys)
+    assert rec["value"] == 350.0
+    assert len(rec["degraded_from"]) == 2
+    # attempt 2 rules out the Pallas path; attempt 3 forces CPU in-process
+    assert "MOCO_TPU_DISABLE_FUSED" not in calls[0]
+    assert calls[1].get("MOCO_TPU_DISABLE_FUSED") == "1"
+    assert calls[2].get("MOCO_TPU_FORCE_CPU") == "1"
+
+
+def test_orchestrate_total_failure_emits_error_record(capsys):
+    with mock.patch.object(bench, "_run_child",
+                           return_value=(None, "timeout after 900s")), \
+         mock.patch.object(bench.time, "sleep"):
+        bench.orchestrate("e2e")
+    rec = _parse_only_line(capsys)
+    assert rec["metric"] == "moco_v2_r50_e2e_input_fed_throughput_per_chip"
+    assert rec["value"] == 0.0 and "error" in rec
+
+
+def test_run_child_extracts_last_json_line(tmp_path):
+    """The child may print progress lines; only the LAST metric-bearing JSON
+    line counts."""
+    proc = mock.Mock(returncode=0, stderr="", stdout=(
+        "warming up\n"
+        '{"not_a_metric": 1}\n'
+        '{"metric": "m", "value": 1.0}\n'
+        "trailing noise\n"
+    ))
+    with mock.patch.object(bench.subprocess, "run", return_value=proc):
+        parsed, err = bench._run_child("step", 10.0, {})
+    assert err is None and parsed["metric"] == "m"
+
+
+def test_run_child_reports_rc_and_tail():
+    proc = mock.Mock(returncode=1, stdout="", stderr="line1\nBOOM: died\n")
+    with mock.patch.object(bench.subprocess, "run", return_value=proc):
+        parsed, err = bench._run_child("step", 10.0, {})
+    assert parsed is None and "rc=1" in err and "BOOM" in err
