@@ -450,7 +450,10 @@ def _use_pallas_blur(cfg: AugConfig) -> bool:
         return False
     if cfg.pallas_blur == "on":
         return True
-    return jax.default_backend() == "tpu"
+    import os
+
+    return (jax.default_backend() == "tpu"
+            and not os.environ.get("MOCO_TPU_DISABLE_PALLAS"))
 
 
 def _sample_keys(key: jax.Array, start, n: int) -> jax.Array:

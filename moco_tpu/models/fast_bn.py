@@ -39,7 +39,13 @@ from moco_tpu.ops.pallas_stats import channel_grad_sums, channel_sums
 
 
 def _use_pallas() -> bool:
-    return jax.default_backend() == "tpu"
+    # MOCO_TPU_DISABLE_PALLAS: global kill-switch so the bench orchestrator's
+    # retry can rule out EVERY custom Pallas kernel (not just the fused-conv
+    # family) as the cause of an on-chip failure
+    import os
+
+    return (jax.default_backend() == "tpu"
+            and not os.environ.get("MOCO_TPU_DISABLE_PALLAS"))
 
 
 def _batch_stats(x, use_pallas):

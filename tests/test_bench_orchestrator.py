@@ -1,8 +1,9 @@
-"""bench.py orchestrator logic (VERDICT r2 #1): retry env plumbing, JSON
-extraction, degradation record, and the always-one-JSON-line guarantee —
-unit-tested with a stubbed child so no backend (or 25-minute timeout) is
-involved. The live paths are exercised against the real dead/alive backend
-separately (BENCH artifacts)."""
+"""bench.py orchestrator logic (VERDICT r2 #1, r3 #1/#6): cheap-first
+ordering, provisional-then-upgrade printing, retry env plumbing, JSON
+extraction, the hard total budget, and the SIGTERM flush — unit-tested with
+a stubbed child so no backend (or multi-minute timeout) is involved. The
+live paths are exercised against the real dead/alive backend separately
+(BENCH artifacts)."""
 
 import json
 import sys
@@ -12,54 +13,192 @@ sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
 import bench
 
 
-def _parse_only_line(capsys):
-    out = capsys.readouterr().out.strip().splitlines()
-    assert len(out) == 1, out
-    return json.loads(out[0])
+def _lines(capsys):
+    return [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
 
 
-def test_orchestrate_passes_through_first_success(capsys):
-    ok = {"metric": "moco_v2_r50_pretrain_throughput_per_chip",
-          "value": 2000.0, "unit": "imgs/sec/chip", "vs_baseline": 11.9}
-    with mock.patch.object(bench, "_run_child", return_value=(ok, None)) as rc:
-        bench.orchestrate("step")
-    rec = _parse_only_line(capsys)
-    assert rec == ok  # no degraded_from on a clean first attempt
-    (mode, timeout, env), _ = rc.call_args
-    assert mode == "step" and "MOCO_TPU_DISABLE_FUSED" not in env
+class FakeClock:
+    """time.monotonic stub; _run_child stubs advance it by the timeout they
+    were granted (simulating a child that burns its whole cap)."""
+
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
 
 
-def test_orchestrate_retry_disables_fused_then_degrades(capsys):
+def _patch_clock(clock):
+    return (mock.patch.object(bench.time, "monotonic", clock),
+            mock.patch.object(bench.time, "sleep",
+                              lambda s: setattr(clock, "t", clock.t + s)))
+
+
+PROXY = {"metric": "moco_v2_tiny_cpu_proxy_throughput_per_chip",
+         "value": 316.0, "unit": "imgs/sec/chip", "vs_baseline": 1.88}
+TPU = {"metric": "moco_v2_r50_pretrain_throughput_per_chip",
+       "value": 2000.0, "unit": "imgs/sec/chip", "vs_baseline": 11.9}
+INPUT = {"metric": "host_staging_throughput", "value": 482.1,
+         "unit": "imgs/sec", "vs_baseline": 0.36,
+         "detail": {"native_s512_2t": 482.1},
+         "cores_per_8x1650imgs_chip_host": 28.5}
+E2E = {"metric": "moco_v2_r50_e2e_input_fed_throughput_per_chip",
+       "value": 1500.0, "unit": "imgs/sec/chip", "vs_baseline": 8.9}
+
+
+def _fake_child(clock, outcomes):
+    """outcomes: {mode or (mode, 'MOCO_TPU_DISABLE_FUSED'): result|None}.
+    Burns 45 s on success, the full granted timeout on failure/hang."""
     calls = []
 
     def fake(mode, timeout_s, env):
-        calls.append(dict(env))
-        if len(calls) < 3:
-            return None, f"rc=1: boom{len(calls)}"
-        return ({"metric": "moco_v2_tiny_cpu_proxy_throughput_per_chip",
-                 "value": 350.0, "unit": "imgs/sec/chip",
-                 "vs_baseline": 2.08}, None)
+        env = env or {}
+        calls.append((mode, timeout_s, dict(env)))
+        key = (mode, "fused_off") if env.get("MOCO_TPU_DISABLE_FUSED") else mode
+        forced_cpu = env.get("MOCO_TPU_FORCE_CPU")
+        result = outcomes.get(key if key in outcomes else mode)
+        if callable(result):
+            result = result(forced_cpu)
+        if result is None:
+            clock.t += timeout_s
+            return None, f"timeout after {timeout_s:.0f}s"
+        clock.t += 45.0
+        return dict(result), None
 
-    with mock.patch.object(bench, "_run_child", side_effect=fake), \
-         mock.patch.object(bench.time, "sleep"):
+    return fake, calls
+
+
+def test_tpu_up_prints_provisional_then_upgraded_line(capsys):
+    clock = FakeClock()
+    fake, calls = _fake_child(clock, {"step": lambda cpu: PROXY if cpu else TPU,
+                                      "input": INPUT, "e2e": E2E})
+    p1, p2 = _patch_clock(clock)
+    with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
         bench.orchestrate("step")
-    rec = _parse_only_line(capsys)
-    assert rec["value"] == 350.0
-    assert len(rec["degraded_from"]) == 2
-    # attempt 2 rules out the Pallas path; attempt 3 forces CPU in-process
-    assert "MOCO_TPU_DISABLE_FUSED" not in calls[0]
-    assert calls[1].get("MOCO_TPU_DISABLE_FUSED") == "1"
-    assert calls[2].get("MOCO_TPU_FORCE_CPU") == "1"
+    out = _lines(capsys)
+    assert len(out) == 2  # provisional first, upgrade LAST (driver takes last)
+    assert out[0]["metric"] == PROXY["metric"]
+    assert out[-1]["metric"] == TPU["metric"] and out[-1]["value"] == 2000.0
+    assert out[-1]["input"]["value"] == 482.1
+    assert out[-1]["e2e"]["value"] == 1500.0
+    # cpu proxy ran FIRST; e2e ran on the TPU (no FORCE_CPU) since TPU worked
+    assert calls[0][0] == "step" and calls[0][2].get("MOCO_TPU_FORCE_CPU")
+    assert calls[-1][0] == "e2e" and not calls[-1][2].get("MOCO_TPU_FORCE_CPU")
 
 
-def test_orchestrate_total_failure_emits_error_record(capsys):
-    with mock.patch.object(bench, "_run_child",
-                           return_value=(None, "timeout after 900s")), \
-         mock.patch.object(bench.time, "sleep"):
+def test_tpu_hang_keeps_proxy_and_stays_inside_budget(capsys):
+    clock = FakeClock()
+    t_start = clock.t
+    fake, calls = _fake_child(
+        clock, {"step": lambda cpu: PROXY if cpu else None,
+                "input": INPUT, "e2e": lambda cpu: E2E if cpu else None})
+    p1, p2 = _patch_clock(clock)
+    with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
+        bench.orchestrate("step")
+    out = _lines(capsys)
+    assert out[-1]["metric"] == PROXY["metric"] and out[-1]["value"] == 316.0
+    assert any("timeout" in e for e in out[-1]["degraded_from"])
+    assert out[-1]["input"]["value"] == 482.1
+    # THE budget property (VERDICT r3 weak #1): wall time consumed by all
+    # children + sleeps stays under the hard cap even when the TPU hangs
+    assert clock.t - t_start <= bench.BENCH_TOTAL_BUDGET_S
+    # e2e after a TPU hang must force CPU (never probe a dead relay twice)
+    e2e_calls = [c for c in calls if c[0] == "e2e"]
+    assert all(c[2].get("MOCO_TPU_FORCE_CPU") for c in e2e_calls)
+
+
+def test_fast_tpu_failure_retries_with_fused_disabled(capsys):
+    clock = FakeClock()
+
+    def fake(mode, timeout_s, env):
+        env = env or {}
+        if env.get("MOCO_TPU_FORCE_CPU"):
+            clock.t += 45.0
+            return dict(PROXY) if mode != "input" else dict(INPUT), None
+        if env.get("MOCO_TPU_DISABLE_FUSED"):
+            clock.t += 60.0
+            return dict(TPU), None
+        clock.t += 30.0  # fast rc=1 (Mosaic compile error shape)
+        return None, "rc=1: Mosaic lowering failed"
+
+    p1, p2 = _patch_clock(clock)
+    with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
+        bench.orchestrate("step")
+    out = _lines(capsys)
+    assert out[-1]["value"] == 2000.0
+    assert any("Mosaic" in e for e in out[-1]["degraded_from"])
+
+
+def test_everything_fails_emits_error_record(capsys):
+    clock = FakeClock()
+    p1, p2 = _patch_clock(clock)
+
+    def fake(mode, timeout_s, env):
+        clock.t += min(timeout_s, 30.0)
+        return None, "rc=1: boom"
+
+    with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
         bench.orchestrate("e2e")
-    rec = _parse_only_line(capsys)
+    out = _lines(capsys)
+    assert len(out) == 1
+    rec = out[0]
     assert rec["metric"] == "moco_v2_r50_e2e_input_fed_throughput_per_chip"
-    assert rec["value"] == 0.0 and "error" in rec
+    assert rec["value"] == 0.0 and rec["degraded_from"]
+
+
+def test_input_mode_single_cpu_child(capsys):
+    clock = FakeClock()
+    fake, calls = _fake_child(clock, {"input": INPUT})
+    p1, p2 = _patch_clock(clock)
+    with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
+        bench.orchestrate("input")
+    out = _lines(capsys)
+    assert len(out) == 1 and out[0]["value"] == 482.1
+    assert len(calls) == 1 and calls[0][2].get("MOCO_TPU_FORCE_CPU")
+
+
+def test_sigterm_flushes_best_so_far(capsys):
+    """The handler must emit the provisional record + evidence trail."""
+    import signal
+
+    clock = FakeClock()
+    handler = {}
+
+    def fake_signal(sig, fn):
+        handler[sig] = fn
+
+    def fake(mode, timeout_s, env):
+        if (env or {}).get("MOCO_TPU_FORCE_CPU") and mode == "step":
+            clock.t += 45.0
+            return dict(PROXY), None
+        # simulate the driver SIGTERMing us mid-TPU-attempt
+        with mock.patch.object(bench.os, "_exit", side_effect=SystemExit):
+            try:
+                handler[signal.SIGTERM](signal.SIGTERM, None)
+            except SystemExit:
+                pass
+        raise KeyboardInterrupt  # stop the orchestration like a real kill
+
+    p1, p2 = _patch_clock(clock)
+    with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake), \
+         mock.patch.object(bench.signal, "signal", fake_signal):
+        try:
+            bench.orchestrate("step")
+        except KeyboardInterrupt:
+            pass
+    out = _lines(capsys)
+    # provisional line + the SIGTERM flush, both carrying the proxy number
+    assert out[0]["value"] == 316.0
+    assert out[-1]["value"] == 316.0
+    assert any("signal" in e for e in out[-1]["degraded_from"])
+
+
+def test_budget_exhaustion_skips_children():
+    clock = FakeClock()
+    with mock.patch.object(bench.time, "monotonic", clock):
+        orch = bench._Orchestrator("step", 0.0)
+        result = orch.run("tpu", "step", 100.0, {})
+    assert result is None and "budget exhausted" in orch.errors[0]
 
 
 def test_run_child_extracts_last_json_line(tmp_path):

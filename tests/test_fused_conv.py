@@ -228,7 +228,11 @@ def test_full_benchmark_step_lowers_for_tpu():
     )
 
     B = 128
-    config = get_preset("imagenet-moco-v2").replace(batch_size=B)
+    # fused ON explicitly: the census pins the CANDIDATE fused program's
+    # lowering (the shipping default is OFF until _fused_validate proves it
+    # on a chip — config.py::fused_bn_conv)
+    config = get_preset("imagenet-moco-v2").replace(
+        batch_size=B, fused_bn_conv=True)
     mesh = create_mesh(1)
     with mock.patch.object(jax, "default_backend", lambda: "tpu"), \
          mock.patch.object(fbn, "_use_pallas", lambda: True), \
