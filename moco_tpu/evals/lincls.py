@@ -100,10 +100,11 @@ def init_classifier(rng, feat_dim: int, num_classes: int):
     return {"w": w, "b": jnp.zeros((num_classes,), jnp.float32)}
 
 
-def build_lincls_steps(config: EvalConfig, model, tx, mesh):
+def build_lincls_steps(model, tx):
     """Jitted train/eval steps. Sharding is data-parallel via the automatic
     partitioner (no shard_map needed: BN is frozen, so there are no
-    per-device-statistics semantics to preserve)."""
+    per-device-statistics semantics to preserve — the mesh enters only via
+    the input shardings the caller applies to each batch)."""
 
     def features(params, stats, images):
         # eval-mode BN even while training the probe (`model.eval()`)
@@ -230,7 +231,7 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
         optax.sgd(sched, momentum=config.sgd_momentum),
     )
     opt_state = tx.init(fc)
-    train_step, eval_step = build_lincls_steps(config, model, tx, mesh)
+    train_step, eval_step = build_lincls_steps(model, tx)
 
     # reference train transform: RandomResizedCrop(scale 0.08-1) + flip
     aug = v1_aug_config(config.image_size)._replace(
