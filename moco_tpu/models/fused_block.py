@@ -32,7 +32,7 @@ import jax.numpy as jnp
 
 from moco_tpu.models.fast_bn import _batch_stats, _normalize, _use_pallas
 from moco_tpu.ops.pallas_fused_conv import bn_relu_matmul, bn_relu_matmul_dw
-from moco_tpu.ops.pallas_fused_conv3x3 import bn_relu_conv3x3
+from moco_tpu.ops.pallas_fused_conv3x3 import bn_relu_conv3x3, conv3x3_dw
 from moco_tpu.ops.pallas_stats import channel_grad_sums
 
 
@@ -182,11 +182,19 @@ def _bwd3x3(eps, dtype, res, cts):
     a = (scale * rstd).astype(jnp.float32)
     shift = (bias - mean * a).astype(jnp.float32)
     zpre = x.astype(jnp.float32) * a + shift
-    z = jnp.maximum(zpre, 0.0).astype(dtype)
-    # exact conv backprops (filter-grad and input-grad convs) via jax.vjp on
-    # the reference conv — XLA emits the standard transposed convolutions
-    _, conv_vjp = jax.vjp(lambda z_, w_: _conv3x3(z_, w_, dtype), z, w4d)
-    dz, dw = conv_vjp(dy)
+    # the input-gradient never reads z's VALUE — it is the transposed conv
+    # of dy with the spatially-flipped, channel-transposed taps, already an
+    # optimal MXU conv as plain XLA on every backend
+    dz = _conv3x3(dy, w4d[::-1, ::-1].transpose(0, 1, 3, 2), dtype)
+    if _use_pallas():
+        # filter gradient with ẑ recomputed in VMEM (conv3x3_dw): z now
+        # never exists in HBM in the backward either; the ReLU mask below
+        # fuses into g's multiply
+        dw = conv3x3_dw(x, a, shift, dy).astype(w4d.dtype)
+    else:
+        z = jnp.maximum(zpre, 0.0).astype(dtype)
+        _, conv_vjp = jax.vjp(lambda w_: _conv3x3(z, w_, dtype), w4d)
+        (dw,) = conv_vjp(dy)
     g = dz.astype(jnp.float32) * (zpre > 0)
     if _use_pallas():
         dsum, dxh = channel_grad_sums(g, x, mean, rstd)

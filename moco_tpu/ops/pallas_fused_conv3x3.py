@@ -18,6 +18,16 @@ strided halo tiling buys 4 of 16 blocks and is not worth the index
 complexity. `interpret=True` runs on CPU for the equivalence tests;
 `tests/test_fused_conv3x3.py` also pins the TPU (Mosaic) lowering
 hardware-free via cross-platform export.
+
+The backward twin `conv3x3_dw` (VERDICT r3 #5) closes the remaining HBM
+leak: the custom VJP used to materialize z = relu(x̂) in HBM solely to feed
+the filter-gradient correlation (the input-gradient dz never reads z — it
+is a transposed conv of dy, already optimal as plain XLA). Here the nine
+tap gradients dW[di,dj] = Σ z[i+di, j+dj]ᵀ·dy[i,j] accumulate in one VMEM
+scratch while z is recomputed tile-by-tile from x with the same halo refs
+and edge masks as the forward — so the normalized activation now never
+exists in HBM in EITHER direction for the 3x3, matching the 1x1 tail's
+`bn_relu_matmul_dw` story.
 """
 
 from __future__ import annotations
@@ -101,6 +111,75 @@ def _conv3x3_kernel(xm_ref, x0_ref, xp_ref, a_ref, b_ref, w_ref, o_ref, *,
     o_ref[...] = acc.reshape(bh, bw, n).astype(o_ref.dtype)
 
 
+def _dw3x3_kernel(xm_ref, x0_ref, xp_ref, a_ref, b_ref, dy_ref, o_ref,
+                  acc_ref, *, bh, h, blocks_per_img):
+    """Accumulate the nine tap gradients over row-blocks.
+
+    Grid is (n_blocks, row_blocks) with the ROW dim last (the sequential
+    accumulation axis, `_dw_kernel` convention): for each row-block the
+    di/dj-shifted masked ẑ views — identical construction to the forward —
+    contract against the local dy tile, `acc[tap] += ẑ_tapᵀ @ dy`.
+    """
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    dy = dy_ref[...]
+    bw = x0_ref.shape[1]
+    k = x0_ref.shape[2]
+
+    def normalize(ref):
+        x = ref[...].astype(jnp.float32)
+        return jnp.maximum(x * a_ref[0, 0] + b_ref[0, 0], 0.0).astype(dy.dtype)
+
+    zm = normalize(xm_ref)
+    z0 = normalize(x0_ref)
+    zp = normalize(xp_ref)
+
+    col = jax.lax.broadcasted_iota(jnp.int32, (bh, bw, 1), 1)
+    row_in_block = jax.lax.broadcasted_iota(jnp.int32, (bh, bw, 1), 0)
+    img_row = (i % blocks_per_img) * bh + row_in_block
+    dyr = dy.reshape(bh * bw, dy.shape[-1])
+
+    for di in (-1, 0, 1):
+        if di == 0:
+            z_rows = z0
+            row_ok = jnp.ones((bh, bw, 1), jnp.bool_)
+        elif di == -1:
+            z_rows = zm if bh == 1 else jnp.concatenate([zm, z0[:-1]], axis=0)
+            row_ok = img_row - 1 >= 0
+        else:
+            z_rows = zp if bh == 1 else jnp.concatenate([z0[1:], zp], axis=0)
+            row_ok = img_row + 1 <= h - 1
+        for dj in (-1, 0, 1):
+            if dj == 0:
+                z_tap = z_rows
+                col_ok = jnp.ones((bh, bw, 1), jnp.bool_)
+            elif dj == -1:
+                z_tap = jnp.concatenate(
+                    [jnp.zeros_like(z_rows[:, :1]), z_rows[:, :-1]], axis=1
+                )
+                col_ok = col - 1 >= 0
+            else:
+                z_tap = jnp.concatenate(
+                    [z_rows[:, 1:], jnp.zeros_like(z_rows[:, :1])], axis=1
+                )
+                col_ok = col + 1 <= bw - 1
+            mask = (row_ok & col_ok).astype(z_tap.dtype)
+            z_masked = (z_tap * mask).reshape(bh * bw, k)
+            tap = (di + 1) * 3 + (dj + 1)
+            acc_ref[tap] += jax.lax.dot_general(
+                z_masked, dyr, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _():
+        o_ref[...] = acc_ref[...]
+
+
 def _pick_rows(h: int, w: int, k: int) -> int:
     """Row-block: target a few hundred KB of z tile, divide H."""
     target = max(1, (256 << 10) // max(1, 2 * w * k))
@@ -168,3 +247,65 @@ def bn_relu_conv3x3(
     )(xr, xr, xr, a.reshape(1, 1, k).astype(jnp.float32),
       b.reshape(1, 1, k).astype(jnp.float32), w9)
     return out.reshape(bsz, h, wd, n)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def conv3x3_dw(
+    x: jax.Array,      # [B, H, W, K] pre-normalize activations
+    a: jax.Array,      # [K] f32 (γ·rstd)
+    b: jax.Array,      # [K] f32 (β − μ·γ·rstd)
+    dy: jax.Array,     # [B, H, W, N] upstream cotangent
+    interpret: bool = False,
+) -> jax.Array:
+    """dW[3, 3, K, N] of relu(x·a+b) ⊛ w with ẑ recomputed in VMEM.
+
+    The [9,K,bn] f32 accumulator lives in VMEM across the row grid,
+    N-blocked so the 512-channel stages stay within the ~16 MB/core
+    budget. x and dy stream once PER N-BLOCK (n//bn passes — 2 at the
+    K=N=512 stage, 1 elsewhere); the normalized activation still never
+    exists in HBM, which is the HBM saving the fusion is after.
+    """
+    bsz, h, wd, k = x.shape
+    n = dy.shape[-1]
+    bh = _pick_rows(h, wd, k)
+    xr = x.reshape(bsz * h, wd, k)
+    dyr = dy.reshape(bsz * h, wd, n)
+    nblocks = (bsz * h) // bh
+    blocks_per_img = h // bh
+    # N-block the accumulator: 9·K·bn·4 B ≤ ~4.7 MB at K=512, bn=256
+    bn = n
+    while 9 * k * bn * 4 > (5 << 20) and bn % 2 == 0:
+        bn //= 2
+
+    def idx_cur(j, i):
+        return (i, 0, 0)
+
+    def idx_prev_row(j, i):
+        img = i // blocks_per_img
+        return (jnp.maximum(i * bh - 1, img * h), 0, 0)
+
+    def idx_next_row(j, i):
+        img = i // blocks_per_img
+        return (jnp.minimum((i + 1) * bh, (img + 1) * h - 1), 0, 0)
+
+    vma = getattr(getattr(x, "aval", None), "vma", frozenset())
+    kernel = functools.partial(_dw3x3_kernel, bh=bh, h=h,
+                               blocks_per_img=blocks_per_img)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n // bn, nblocks),
+        in_specs=[
+            pl.BlockSpec((1, wd, k), idx_prev_row),
+            pl.BlockSpec((bh, wd, k), idx_cur),
+            pl.BlockSpec((1, wd, k), idx_next_row),
+            pl.BlockSpec((1, 1, k), lambda j, i: (0, 0, 0)),
+            pl.BlockSpec((1, 1, k), lambda j, i: (0, 0, 0)),
+            pl.BlockSpec((bh, wd, bn), lambda j, i: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((9, k, bn), lambda j, i: (0, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((9, k, n), jnp.float32, vma=vma),
+        scratch_shapes=[pltpu.VMEM((9, k, bn), jnp.float32)],
+        interpret=interpret,
+    )(xr, xr, xr, a.reshape(1, 1, k).astype(jnp.float32),
+      b.reshape(1, 1, k).astype(jnp.float32), dyr)
+    return out.reshape(3, 3, k, n)

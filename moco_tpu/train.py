@@ -265,6 +265,26 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
     )
     done = False
 
+    # untrained-baseline row (VERDICT r3 weak #3): a kNN curve is only
+    # evidence of learning relative to what RANDOM features score on the
+    # same data — print it before any step so every horizon log carries it.
+    # The monitor itself is a mesh-sharded (collective) computation, so
+    # EVERY process must enter it; only the print/writer are main-gated
+    if config.knn_monitor and start_epoch == 0 and global_step == 0:
+        acc0, is_val0 = knn_monitor(
+            config, feature_fn, state, dataset, mesh, val_dataset=monitor_val
+        )
+        tag0 = "knn_val_top1_untrained" if is_val0 else "knn_train_top1_untrained"
+        last_metrics[tag0] = acc0
+        if is_main:
+            print(
+                f"Epoch [-1] kNN({'val' if is_val0 else 'train'}) top-1 "
+                f"{100 * acc0:.2f}% (UNTRAINED baseline; chance "
+                f"{100.0 / dataset.num_classes:.2f}%)",
+                flush=True,
+            )
+            writer.write(0, {tag0: acc0})
+
     try:
         for epoch in range(start_epoch, config.epochs):
             if done:

@@ -16,7 +16,7 @@ import numpy as np
 import pytest
 
 from moco_tpu.models.fused_block import _bn_relu_conv3x3_train
-from moco_tpu.ops.pallas_fused_conv3x3 import bn_relu_conv3x3
+from moco_tpu.ops.pallas_fused_conv3x3 import bn_relu_conv3x3, conv3x3_dw
 
 
 def _ref(x, a, b, w):
@@ -62,6 +62,55 @@ def test_batch_boundary_no_halo_leak_interpret():
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(both[1]), np.asarray(solo1[0]),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "shape", [(2, 8, 8, 16, 32), (3, 12, 10, 8, 16), (1, 4, 16, 32, 8)]
+)
+def test_dw_kernel_matches_conv_filter_grad_interpret(shape):
+    """conv3x3_dw == autodiff's filter gradient of relu(x·a+b) ⊛ w."""
+    bsz, h, w_, k, n = shape
+    x = jax.random.normal(jax.random.key(10), (bsz, h, w_, k), jnp.float32)
+    a = 1.0 + 0.1 * jax.random.normal(jax.random.key(11), (k,))
+    b = 0.1 * jax.random.normal(jax.random.key(12), (k,))
+    w = 0.1 * jax.random.normal(jax.random.key(13), (3, 3, k, n))
+    dy = jax.random.normal(jax.random.key(14), (bsz, h, w_, n), jnp.float32)
+    _, vjp = jax.vjp(lambda w_: _ref(x, a, b, w_), w)
+    (want,) = vjp(dy)
+    got = conv3x3_dw(x, a, b, dy, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_dw_kernel_batch_boundary_no_halo_leak_interpret():
+    """The tap gradients must pair z and dy WITHIN an image only — summing
+    per-image filter grads of wildly different images equals the batched
+    call iff no halo leaks across the folded batch dimension."""
+    k, n = 8, 8
+    x0 = jax.random.normal(jax.random.key(15), (1, 4, 4, k)) * 100.0
+    x1 = -x0 + jax.random.normal(jax.random.key(16), (1, 4, 4, k))
+    a = jnp.ones((k,))
+    b = jnp.zeros((k,))
+    dy = jax.random.normal(jax.random.key(17), (2, 4, 4, n), jnp.float32)
+    both = conv3x3_dw(jnp.concatenate([x0, x1]), a, b, dy, interpret=True)
+    solo = (conv3x3_dw(x0, a, b, dy[:1], interpret=True)
+            + conv3x3_dw(x1, a, b, dy[1:], interpret=True))
+    np.testing.assert_allclose(np.asarray(both), np.asarray(solo),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_dw_kernel_lowers_for_tpu_at_r50_shapes():
+    for (bsz, h, w_, k) in [
+        (128, 56, 56, 64), (128, 28, 28, 128),
+        (128, 14, 14, 256), (128, 7, 7, 512),
+    ]:
+        x = jax.ShapeDtypeStruct((bsz, h, w_, k), jnp.bfloat16)
+        a = jax.ShapeDtypeStruct((k,), jnp.float32)
+        b = jax.ShapeDtypeStruct((k,), jnp.float32)
+        dy = jax.ShapeDtypeStruct((bsz, h, w_, k), jnp.bfloat16)
+        fn = lambda x, a, b, dy: conv3x3_dw(x, a, b, dy)
+        exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(x, a, b, dy)
+        assert "tpu_custom_call" in exp.mlir_module(), (bsz, h, w_, k)
 
 
 def test_custom_vjp_matches_autodiff():

@@ -39,6 +39,37 @@ dw_err = np.abs(dw_got - dw_want) / (np.abs(dw_want) + 1.0)
 print(f"dW kernel rel err: mean {dw_err.mean():.2e} max {dw_err.max():.2e}")
 assert dw_err.max() < 0.05, "dW kernel numerics off on TPU"
 
+# --- 1c) 3x3 kernels: forward + dW backward numerics ---
+from moco_tpu.ops.pallas_fused_conv3x3 import bn_relu_conv3x3, conv3x3_dw
+
+bsz3, h3, w3, k3, n3 = 8, 28, 28, 128, 128
+x3 = jax.random.normal(jax.random.key(20), (bsz3, h3, w3, k3)).astype(jnp.bfloat16)
+a3 = 1.0 + 0.1 * jax.random.normal(jax.random.key(21), (k3,))
+b3 = 0.1 * jax.random.normal(jax.random.key(22), (k3,))
+w3x3 = (0.05 * jax.random.normal(jax.random.key(23), (3, 3, k3, n3))).astype(jnp.bfloat16)
+dy3 = jax.random.normal(jax.random.key(24), (bsz3, h3, w3, n3)).astype(jnp.bfloat16)
+
+
+def _ref3(x_, w_):
+    z_ = jnp.maximum(x_.astype(jnp.float32) * a3 + b3, 0.0)
+    return jax.lax.conv_general_dilated(
+        z_, w_.astype(jnp.float32), (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+got3 = np.asarray(bn_relu_conv3x3(x3, a3, b3, w3x3, out_dtype=jnp.bfloat16), np.float32)
+want3 = np.asarray(_ref3(x3, w3x3), np.float32)
+err3 = np.abs(got3 - want3) / (np.abs(want3) + 1.0)
+print(f"conv3x3 kernel rel err: mean {err3.mean():.2e} max {err3.max():.2e}")
+assert err3.max() < 0.05, "fused 3x3 kernel numerics off on TPU"
+
+_, _vjp3 = jax.vjp(lambda w_: _ref3(x3, w_), w3x3.astype(jnp.float32))
+(dw3_want,) = _vjp3(jnp.asarray(dy3, jnp.float32))
+dw3_got = np.asarray(conv3x3_dw(x3, a3, b3, dy3), np.float32)
+dw3_err = np.abs(dw3_got - np.asarray(dw3_want)) / (np.abs(np.asarray(dw3_want)) + 1.0)
+print(f"conv3x3 dW kernel rel err: mean {dw3_err.mean():.2e} max {dw3_err.max():.2e}")
+assert dw3_err.max() < 0.05, "3x3 dW kernel numerics off on TPU"
+
 # --- 2) block equivalence on TPU ---
 from functools import partial
 import flax.linen as nn
