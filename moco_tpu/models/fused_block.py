@@ -32,7 +32,11 @@ import jax.numpy as jnp
 
 from moco_tpu.models.fast_bn import _batch_stats, _normalize, _use_pallas
 from moco_tpu.ops.pallas_fused_conv import bn_relu_matmul, bn_relu_matmul_dw
-from moco_tpu.ops.pallas_fused_conv3x3 import bn_relu_conv3x3, conv3x3_dw
+from moco_tpu.ops.pallas_fused_conv3x3 import (
+    bn_relu_conv3x3,
+    bn_relu_conv3x3_s2,
+    conv3x3_dw,
+)
 from moco_tpu.ops.pallas_stats import channel_grad_sums
 
 
@@ -174,10 +178,26 @@ def _fwd3x3(x, scale, bias, w4d, eps, dtype):
     return (y, mean, var), (x, mean, var, scale, bias, w4d)
 
 
+def _bn_chain(g, x, mean, rstd, scale):
+    """The closed-form BN backward shared by every fused conv: given the
+    ReLU-masked gradient g at the normalize output, return (dx, dγ, dβ)."""
+    k = x.shape[-1]
+    if _use_pallas():
+        dsum, dxh = channel_grad_sums(g, x, mean, rstd)
+    else:
+        gf = g.reshape(-1, k)
+        xh = (x.reshape(-1, k).astype(jnp.float32) - mean) * rstd
+        dsum = jnp.sum(gf, axis=0)
+        dxh = jnp.sum(gf * xh, axis=0)
+    nelem = x.size // k
+    xh_full = (x.astype(jnp.float32) - mean) * rstd
+    dx = (scale * rstd) * (g - (xh_full * (dxh / nelem) + dsum / nelem))
+    return dx, dxh, dsum
+
+
 def _bwd3x3(eps, dtype, res, cts):
     x, mean, var, scale, bias, w4d = res
     dy, _dmean, _dvar = cts
-    k = x.shape[-1]
     rstd = jax.lax.rsqrt(var + eps)
     a = (scale * rstd).astype(jnp.float32)
     shift = (bias - mean * a).astype(jnp.float32)
@@ -196,16 +216,7 @@ def _bwd3x3(eps, dtype, res, cts):
         _, conv_vjp = jax.vjp(lambda w_: _conv3x3(z, w_, dtype), w4d)
         (dw,) = conv_vjp(dy)
     g = dz.astype(jnp.float32) * (zpre > 0)
-    if _use_pallas():
-        dsum, dxh = channel_grad_sums(g, x, mean, rstd)
-    else:
-        gf = g.reshape(-1, k)
-        xh = (x.reshape(-1, k).astype(jnp.float32) - mean) * rstd
-        dsum = jnp.sum(gf, axis=0)
-        dxh = jnp.sum(gf * xh, axis=0)
-    nelem = x.size // k
-    xh_full = (x.astype(jnp.float32) - mean) * rstd
-    dx = (scale * rstd) * (g - (xh_full * (dxh / nelem) + dsum / nelem))
+    dx, dxh, dsum = _bn_chain(g, x, mean, rstd, scale)
     return (
         dx.astype(x.dtype),
         dxh.astype(scale.dtype),
@@ -215,6 +226,66 @@ def _bwd3x3(eps, dtype, res, cts):
 
 
 _bn_relu_conv3x3_train.defvjp(_fwd3x3, _bwd3x3)
+
+
+def _conv3x3s2(z, w4d, dtype):
+    return jax.lax.conv_general_dilated(
+        z, w4d.astype(dtype), (2, 2), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _plain_apply3x3s2(x, mean, var, scale, bias, w4d, eps, dtype):
+    z = nn.relu(_normalize(x, mean, var, scale, bias, eps, dtype))
+    return _conv3x3s2(z, w4d, dtype)
+
+
+def _train3x3s2_impl(x, scale, bias, w4d, eps, dtype):
+    mean, var = _batch_stats(x, _use_pallas())
+    if _use_pallas():
+        rstd = jax.lax.rsqrt(var + eps)
+        a = scale * rstd
+        y = bn_relu_conv3x3_s2(x, a, bias - mean * a, w4d, out_dtype=dtype)
+    else:
+        y = _plain_apply3x3s2(x, mean, var, scale, bias, w4d, eps, dtype)
+    return y, mean, var
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _bn_relu_conv3x3s2_train(x, scale, bias, w4d, eps, dtype):
+    return _train3x3s2_impl(x, scale, bias, w4d, eps, dtype)
+
+
+def _fwd3x3s2(x, scale, bias, w4d, eps, dtype):
+    y, mean, var = _train3x3s2_impl(x, scale, bias, w4d, eps, dtype)
+    return (y, mean, var), (x, mean, var, scale, bias, w4d)
+
+
+def _bwd3x3s2(eps, dtype, res, cts):
+    """Stride-2 backward: z is recomputed (not stored — the forward kernel
+    never wrote it) and materialized ONCE here for the two conv VJPs; the
+    fusion still nets one HBM round-trip vs the unfused block, whose
+    forward writes z AND whose backward reads it back."""
+    x, mean, var, scale, bias, w4d = res
+    dy, _dmean, _dvar = cts
+    rstd = jax.lax.rsqrt(var + eps)
+    a = (scale * rstd).astype(jnp.float32)
+    shift = (bias - mean * a).astype(jnp.float32)
+    zpre = x.astype(jnp.float32) * a + shift
+    z = jnp.maximum(zpre, 0.0).astype(dtype)
+    _, conv_vjp = jax.vjp(lambda z_, w_: _conv3x3s2(z_, w_, dtype), z, w4d)
+    dz, dw = conv_vjp(dy)
+    g = dz.astype(jnp.float32) * (zpre > 0)
+    dx, dxh, dsum = _bn_chain(g, x, mean, rstd, scale)
+    return (
+        dx.astype(x.dtype),
+        dxh.astype(scale.dtype),
+        dsum.astype(bias.dtype),
+        dw.astype(w4d.dtype),
+    )
+
+
+_bn_relu_conv3x3s2_train.defvjp(_fwd3x3s2, _bwd3x3s2)
 
 
 def _fused_bn_relu_conv(
@@ -275,11 +346,23 @@ def fused_bn_relu_conv2(
     eps: float, dtype,
 ) -> jax.Array:
     """The bn1→relu→conv2 (3x3, stride-1) interior fusion — Bottleneck mids
-    and BasicBlock tails; stride-2 sites keep the unfused path (callers
-    gate)."""
+    and BasicBlock tails."""
     return _fused_bn_relu_conv(
         mdl, x, "bn1", "conv2", (3, 3, x.shape[-1], features), train,
         momentum, eps, dtype, _plain_apply3x3, _bn_relu_conv3x3_train,
+    )
+
+
+def fused_bn_relu_conv2_s2(
+    mdl: nn.Module, x, features: int, train: bool, momentum: float,
+    eps: float, dtype,
+) -> jax.Array:
+    """The stride-2 bn1→relu→conv2 fusion — the stage-first Bottleneck
+    blocks (VERDICT r3 #5); forward through the Pallas stride-2 kernel,
+    backward recomputes z once for the plain-XLA conv VJPs."""
+    return _fused_bn_relu_conv(
+        mdl, x, "bn1", "conv2", (3, 3, x.shape[-1], features), train,
+        momentum, eps, dtype, _plain_apply3x3s2, _bn_relu_conv3x3s2_train,
     )
 
 

@@ -136,9 +136,10 @@ class Bottleneck(nn.Module):
     """1x1 → 3x3(stride) → 1x1(x4) residual block (ResNet-50/101/152, v1.5).
 
     `fused_tail=True` computes BOTH interior normalize passes through Pallas
-    fused kernels (models/fused_block.py): bn1→relu→conv2 (3x3, stride-1
-    blocks) and bn2→relu→conv3 (1x1, all blocks) — identical
-    params/names/math, the normalized activations never materialize in HBM.
+    fused kernels (models/fused_block.py): bn1→relu→conv2 (3x3; stride-1
+    mids AND the stride-2 stage-first blocks) and bn2→relu→conv3 (1x1, all
+    blocks) — identical params/names/math, the normalized activations never
+    materialize in HBM.
     Engages the kernels on TPU only; incompatible with SyncBN (callers gate
     on that)."""
 
@@ -158,15 +159,18 @@ class Bottleneck(nn.Module):
         if self.fused_tail:
             from moco_tpu.models.fused_block import (
                 fused_bn_relu_conv2,
+                fused_bn_relu_conv2_s2,
                 fused_bn_relu_conv3,
                 norm_train_flag,
             )
 
             train = norm_train_flag(self.norm)
-        if self.fused_tail and self.strides == 1:
             # interior fusion #2: bn1→relu→conv2 through the Pallas 3x3
-            # kernel (stride-2 stage-first blocks keep the unfused path)
-            y = fused_bn_relu_conv2(
+            # kernels — stride-1 mids and (since r4) the stride-2
+            # stage-first blocks
+            fuse2 = (fused_bn_relu_conv2 if self.strides == 1
+                     else fused_bn_relu_conv2_s2)
+            y = fuse2(
                 self, y, self.filters, train, self.bn_momentum, 1e-5,
                 self.dtype,
             )

@@ -249,6 +249,120 @@ def bn_relu_conv3x3(
     return out.reshape(bsz, h, wd, n)
 
 
+def _conv3x3s2_kernel(xm_ref, x0_ref, a_ref, b_ref, w_ref, o_ref, *,
+                      bho, blocks_per_img):
+    """One OUTPUT row-block [bho, W/2, N] of the stride-2 fused conv.
+
+    x0 holds the 2·bho input rows [2r₀, 2r₀+2bho) — output row r reads
+    input rows 2r−1/2r/2r+1 (symmetric pad 1, torch semantics), so the even
+    rows of x0 are the di=0 taps, the odd rows the di=+1 taps, and di=−1 is
+    the odd rows shifted down with xm (the single row above, clamped within
+    the image) sliding in at the top. With H and W even, only the image-top
+    row (di=−1) and the first output column (dj=−1) ever touch padding —
+    the only two masks in the kernel.
+    """
+    i = pl.program_id(0)
+    w_all = w_ref[...]
+    w_in = x0_ref.shape[1]
+    k = x0_ref.shape[2]
+    n = w_all.shape[-1]
+    wo = w_in // 2
+
+    def normalize(ref):
+        x = ref[...].astype(jnp.float32)
+        return jnp.maximum(x * a_ref[0, 0] + b_ref[0, 0], 0.0).astype(w_all.dtype)
+
+    zm = normalize(xm_ref)                       # [1, W, K] row 2r₀−1
+    zpair = normalize(x0_ref).reshape(bho, 2, w_in, k)
+    even = zpair[:, 0]                           # input rows 2r   [bho, W, K]
+    odd = zpair[:, 1]                            # input rows 2r+1
+    above = zm if bho == 1 else jnp.concatenate([zm, odd[:-1]], axis=0)
+
+    acc = jnp.zeros((bho * wo, n), jnp.float32)
+    out_row = jax.lax.broadcasted_iota(jnp.int32, (bho, wo, 1), 0)
+    img_out_row = (i % blocks_per_img) * bho + out_row
+    out_col = jax.lax.broadcasted_iota(jnp.int32, (bho, wo, 1), 1)
+
+    for di, z_rows in ((-1, above), (0, even), (1, odd)):
+        row_ok = (2 * img_out_row - 1 >= 0) if di == -1 else None
+        pairs = z_rows.reshape(bho, wo, 2, k)
+        for dj in (-1, 0, 1):
+            if dj == 0:
+                z_tap = pairs[:, :, 0]           # input col 2c
+                col_ok = None
+            elif dj == 1:
+                z_tap = pairs[:, :, 1]           # input col 2c+1
+                col_ok = None
+            else:                                # input col 2c−1
+                odd_cols = pairs[:, :, 1]
+                z_tap = jnp.concatenate(
+                    [jnp.zeros_like(odd_cols[:, :1]), odd_cols[:, :-1]],
+                    axis=1,
+                )
+                col_ok = out_col - 1 >= 0
+            ok = row_ok if col_ok is None else (
+                col_ok if row_ok is None else row_ok & col_ok)
+            if ok is not None:
+                z_tap = z_tap * ok.astype(z_tap.dtype)
+            tap = w_all[(di + 1) * 3 + (dj + 1)]
+            acc += jnp.dot(z_tap.reshape(bho * wo, k), tap,
+                           preferred_element_type=jnp.float32)
+    o_ref[...] = acc.reshape(bho, wo, n).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+def bn_relu_conv3x3_s2(
+    x: jax.Array,      # [B, H, W, K] pre-normalize activations (H, W even)
+    a: jax.Array,      # [K] f32 (γ·rstd)
+    b: jax.Array,      # [K] f32 (β − μ·γ·rstd)
+    w: jax.Array,      # [3, 3, K, N] conv kernel
+    out_dtype=jnp.bfloat16,
+    interpret: bool = False,
+) -> jax.Array:
+    """relu(x·a + b) ⊛ w at stride 2, symmetric pad 1 — the stage-first
+    Bottleneck conv2 sites (VERDICT r3 #5), normalized tensor VMEM-only."""
+    bsz, h, wd, k = x.shape
+    assert h % 2 == 0 and wd % 2 == 0, (h, wd)
+    n = w.shape[-1]
+    ho = h // 2
+    # one output row costs two input rows of VMEM: halve the row target
+    bho = _pick_rows(ho, wd, 2 * k)
+    xr = x.reshape(bsz * h, wd, k)
+    w9 = w.reshape(9, k, n).astype(x.dtype)
+    nblocks = (bsz * ho) // bho
+    blocks_per_img = ho // bho
+
+    def idx_cur(i):
+        # output block i consumes the contiguous input rows
+        # [2·bho·i, 2·bho·(i+1)) — block-aligned by construction
+        return (i, 0, 0)
+
+    def idx_above(i):
+        img = i // blocks_per_img
+        return (jnp.maximum(2 * bho * i - 1, img * h), 0, 0)
+
+    vma = getattr(getattr(x, "aval", None), "vma", frozenset())
+    kernel = functools.partial(_conv3x3s2_kernel, bho=bho,
+                               blocks_per_img=blocks_per_img)
+    out = pl.pallas_call(
+        kernel,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((1, wd, k), idx_above),
+            pl.BlockSpec((2 * bho, wd, k), idx_cur),
+            pl.BlockSpec((1, 1, k), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, 1, k), lambda i: (0, 0, 0)),
+            pl.BlockSpec((9, k, n), lambda i: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bho, wd // 2, n), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bsz * ho, wd // 2, n), out_dtype,
+                                       vma=vma),
+        interpret=interpret,
+    )(xr, xr, a.reshape(1, 1, k).astype(jnp.float32),
+      b.reshape(1, 1, k).astype(jnp.float32), w9)
+    return out.reshape(bsz, ho, wd // 2, n)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def conv3x3_dw(
     x: jax.Array,      # [B, H, W, K] pre-normalize activations

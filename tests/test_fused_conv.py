@@ -263,9 +263,10 @@ def test_full_benchmark_step_lowers_for_tpu():
         assert names["_grad_sums_kernel"] >= 12, names    # BN bwd reductions
         assert names["_kernel"] >= 4, names               # fused conv3 tails
         assert names["_conv3x3_kernel"] >= 4, names       # fused conv2 mids
+        assert names["_conv3x3s2_kernel"] >= 3, names     # stride-2 conv2s
         assert names["_dw_kernel"] >= 4, names            # fused-tail dW bwd
         assert names["_dw3x3_kernel"] >= 4, names         # fused-mid dW bwd
-        assert mod.count("tpu_custom_call") >= 41
+        assert mod.count("tpu_custom_call") >= 44
 
 
 def test_dw_kernel_matches_reference_interpret():

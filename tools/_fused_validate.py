@@ -70,6 +70,19 @@ dw3_err = np.abs(dw3_got - np.asarray(dw3_want)) / (np.abs(np.asarray(dw3_want))
 print(f"conv3x3 dW kernel rel err: mean {dw3_err.mean():.2e} max {dw3_err.max():.2e}")
 assert dw3_err.max() < 0.05, "3x3 dW kernel numerics off on TPU"
 
+# --- 1d) stride-2 forward kernel numerics ---
+from moco_tpu.ops.pallas_fused_conv3x3 import bn_relu_conv3x3_s2
+
+gots2 = np.asarray(
+    bn_relu_conv3x3_s2(x3, a3, b3, w3x3, out_dtype=jnp.bfloat16), np.float32)
+wants2 = np.asarray(jax.lax.conv_general_dilated(
+    jnp.maximum(x3.astype(jnp.float32) * a3 + b3, 0.0),
+    w3x3.astype(jnp.float32), (2, 2), ((1, 1), (1, 1)),
+    dimension_numbers=("NHWC", "HWIO", "NHWC")), np.float32)
+errs2 = np.abs(gots2 - wants2) / (np.abs(wants2) + 1.0)
+print(f"conv3x3 s2 kernel rel err: mean {errs2.mean():.2e} max {errs2.max():.2e}")
+assert errs2.max() < 0.05, "stride-2 fused kernel numerics off on TPU"
+
 # --- 2) block equivalence on TPU ---
 from functools import partial
 import flax.linen as nn

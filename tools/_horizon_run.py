@@ -17,6 +17,14 @@ Usage: python tools/_horizon_run.py [lr] > runs/horizon_<backend>_r4.log
 import json, math, os, sys, time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if os.environ.get("MOCO_TPU_FORCE_CPU"):
+    # the sandbox sitecustomize force-registers the axon TPU platform, whose
+    # init can HANG for tens of minutes when the tunnel is down — switch
+    # platforms in-process BEFORE the first backend touch (bench.py child
+    # convention)
+    from moco_tpu.parallel.mesh import force_cpu_devices
+
+    force_cpu_devices(1)
 import jax
 from moco_tpu.config import get_preset
 from moco_tpu.data.datasets import SyntheticTextureDataset
