@@ -110,6 +110,7 @@ class SyntheticTextureDataset:
         assert image_size % 8 == 0, "tile period 8 must divide image_size"
         self.num_classes = num_classes
         self.image_size = image_size
+        self.seed = seed  # the monitor derives a held-out val seed from it
         g = np.random.RandomState(7777)
         tiles = g.rand(num_classes, 8, 8).astype(np.float32)
         tiles -= tiles.mean(axis=(1, 2), keepdims=True)  # zero-mean signal

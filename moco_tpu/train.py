@@ -149,6 +149,18 @@ def _monitor_val_split(config, train_dataset):
             return build_dataset("cifar10", config.data_dir, train=False)
         except FileNotFoundError:
             return None
+    if config.dataset == "synthetic_texture":
+        # a held-out draw from the same distribution (class tiles come from
+        # a FIXED seed, so labels align across seeds by construction): the
+        # monitor reports real generalization, not train-set recall
+        from moco_tpu.data.datasets import SyntheticTextureDataset
+
+        return SyntheticTextureDataset(
+            num_samples=2048, image_size=config.image_size,
+            num_classes=config.num_classes,
+            seed=getattr(train_dataset, "seed", 0) + 10007
+            if hasattr(train_dataset, "seed") else 10007,
+        )
     return None
 
 
@@ -254,6 +266,7 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
     resume_skip = global_step % steps_per_epoch
     total_steps = max_steps or config.epochs * steps_per_epoch
     last_metrics: dict = {}
+    baseline_metrics: dict = {}
     feature_fn = make_feature_fn(model, config.variant) if config.knn_monitor else None
     monitor_val = _monitor_val_split(config, dataset) if config.knn_monitor else None
     # observability on process 0 only: every host writing the same tags into
@@ -275,7 +288,9 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
             config, feature_fn, state, dataset, mesh, val_dataset=monitor_val
         )
         tag0 = "knn_val_top1_untrained" if is_val0 else "knn_train_top1_untrained"
-        last_metrics[tag0] = acc0
+        # separate dict: the step loop REBINDS last_metrics each logging
+        # interval, which would silently drop the baseline row
+        baseline_metrics[tag0] = acc0
         if is_main:
             print(
                 f"Epoch [-1] kNN({'val' if is_val0 else 'train'}) top-1 "
@@ -388,7 +403,7 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
 
             export_encoder_q(state, config.export_path)
         print(f"exported encoder -> {config.export_path}", flush=True)
-    return state, last_metrics
+    return state, {**baseline_metrics, **last_metrics}
 
 
 def main(argv=None):
