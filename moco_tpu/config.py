@@ -21,7 +21,7 @@ class PretrainConfig:
     variant: str = "v2"               # "v1" | "v2" | "v3"
     seed: int = 0
     # model (reference flags -a/--arch, --moco-dim/k/m/t, --mlp)
-    arch: str = "resnet50"            # resnet18/34/50/101 | vit_small/vit_base
+    arch: str = "resnet50"            # resnet18/34/50/101/152 | vit_small/base/large/huge
     embed_dim: int = 128              # --moco-dim
     num_negatives: int = 65536        # --moco-k (ignored for v3)
     momentum_ema: float = 0.999       # --moco-m (v3: base for cosine ramp, 0.99)

@@ -129,11 +129,18 @@ class ViT(nn.Module):
 # attention architecture (ADVICE r1).
 ViT_Small = partial(ViT, width=384, depth=12, num_heads=12)
 ViT_Base = partial(ViT, width=768, depth=12, num_heads=12)
+# the paper's scaling study archs (moco-v3 §4/Table 3: ViT-L/H train with
+# the same recipe at batch 4096; standard timm geometry, 64-dim heads)
+ViT_Large = partial(ViT, width=1024, depth=24, num_heads=16)
+ViT_Huge = partial(ViT, width=1280, depth=32, num_heads=16, patch_size=14)
 # test/debug arch (keeps moco-v3's 32-per-head convention at width 64)
 ViT_Tiny = partial(ViT, width=64, depth=2, num_heads=2)
 
-VIT_ARCHS = {"vit_tiny": ViT_Tiny, "vit_small": ViT_Small, "vit_base": ViT_Base}
-VIT_FEATURE_DIMS = {"vit_tiny": 64, "vit_small": 384, "vit_base": 768}
+VIT_ARCHS = {"vit_tiny": ViT_Tiny, "vit_small": ViT_Small,
+             "vit_base": ViT_Base, "vit_large": ViT_Large,
+             "vit_huge": ViT_Huge}
+VIT_FEATURE_DIMS = {"vit_tiny": 64, "vit_small": 384, "vit_base": 768,
+                    "vit_large": 1024, "vit_huge": 1280}
 
 
 def build_vit(arch: str, num_classes: int | None = None, **kwargs) -> ViT:

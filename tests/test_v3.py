@@ -89,6 +89,41 @@ def v3_setup(mesh8):
     return config, state, step, (x1, x2)
 
 
+def test_vit_large_huge_geometry():
+    """The paper's scaling-study archs (moco-v3 Table 3): ViT-L/16 and
+    ViT-H/14 build with the standard timm geometry and the sin-cos grid
+    matches the patch count — checked shape-only (eval_shape; a real L/H
+    forward is too heavy for the 1-core sandbox)."""
+    import jax
+
+    from moco_tpu.models.vit import VIT_FEATURE_DIMS, build_vit
+
+    for arch, width, depth, heads, patch, grid in (
+        ("vit_large", 1024, 24, 16, 16, 14),
+        ("vit_huge", 1280, 32, 16, 14, 16),
+    ):
+        model = build_vit(arch, num_classes=None)
+        assert model.width == width and model.depth == depth
+        assert model.num_heads == heads and model.patch_size == patch
+        assert VIT_FEATURE_DIMS[arch] == width
+        shapes = jax.eval_shape(
+            lambda m=model: m.init(
+                jax.random.key(0), jnp.zeros((1, 224, 224, 3)), train=False
+            )
+        )
+        pos = shapes["params"]["pos_embed"] if "pos_embed" in shapes["params"] else None
+        # feature output is [1, width]
+        out = jax.eval_shape(
+            lambda v, m=model: m.apply(v, jnp.zeros((1, 224, 224, 3)),
+                                       train=False),
+            shapes,
+        )
+        assert out.shape == (1, width), (arch, out.shape)
+        n_blocks = sum(1 for k in shapes["params"] if k.startswith("block"))
+        assert n_blocks == depth, (arch, n_blocks)
+        del pos, grid
+
+
 def test_v3_state_has_no_queue_and_no_predictor_in_k(v3_setup):
     _, state, _, _ = v3_setup
     assert state.queue is None and state.queue_ptr is None
