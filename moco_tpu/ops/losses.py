@@ -59,13 +59,23 @@ def contrastive_accuracy(
 ) -> tuple[jax.Array, ...]:
     """Top-k accuracy over the (K+1)-way logits (rebuild of `accuracy`,
     `main_moco.py:≈L390-405`): the fraction of samples whose positive
-    outranks all queue negatives (within top-k)."""
-    kmax = min(max(topk), logits.shape[-1])  # cheap vs argsorting K+1 columns
-    _, top_idx = lax.top_k(logits, kmax)
-    hits = top_idx == labels[:, None]
-    return tuple(
-        100.0 * jnp.mean(jnp.sum(hits[:, : min(k, kmax)], axis=-1)) for k in topk
+    outranks all queue negatives (within top-k).
+
+    Rank-count formulation instead of `lax.top_k`: the label column is in
+    the top-k iff fewer than k columns score strictly higher. One compare +
+    row-sum over [B, K+1] — O(BK) elementwise, no sort. This matters twice:
+    `lax.top_k` over K+1 columns ran EVERY train step (it dominated the CPU
+    horizon step at K=4096, ~22 of 25 s), and on TPU at K=65536 the per-step
+    sort network is pure overhead for a 2-number metric. Tie semantics:
+    strictly-greater counting credits the positive on exact float ties,
+    matching torch `topk`'s first-occurrence behavior for equal values up
+    to column order."""
+    valid = labels >= 0  # eval paths pad ragged tails with label -1
+    label_logit = jnp.take_along_axis(
+        logits, jnp.maximum(labels, 0)[:, None], axis=-1
     )
+    n_better = jnp.sum((logits > label_logit), axis=-1)  # [B]
+    return tuple(100.0 * jnp.mean((n_better < k) & valid) for k in topk)
 
 
 def v3_contrastive_loss(

@@ -12,7 +12,14 @@ baseline as an `Epoch [-1]` row (train.py knn_monitor), and this tool FAILS
 (exit 1) unless the final kNN beats that baseline by a wide margin and the
 loss visibly departs from the K+1-way chance level log(K+1) = 8.32.
 
-Usage: python tools/_horizon_run.py [lr] > runs/horizon_<backend>_r4.log
+Usage: python tools/_horizon_run.py [lr] [batch] > runs/horizon_<backend>_r4.log
+
+Batch picks the wall-clock budget, not the science: the honest properties
+(resnet18@32, K=4096, 3200 REAL optimizer steps, chance-level untrained
+baseline, val-split monitor, the two gates) hold at any batch. On the TPU
+the config-1 batch 256 run is minutes; on the 1-core CPU sandbox a B=256
+step costs 10-26 s (measured 2026-07-30), so 3200 steps would be >10 h —
+B=64 (default off-TPU) fits the round while keeping 3200 real steps.
 """
 import json, math, os, sys, time
 
@@ -30,20 +37,28 @@ from moco_tpu.config import get_preset
 from moco_tpu.data.datasets import SyntheticTextureDataset
 from moco_tpu.train import train
 
-lr = float(sys.argv[1]) if len(sys.argv) > 1 else 0.06
+on_tpu = jax.default_backend() == "tpu"
+lr = float(sys.argv[1]) if len(sys.argv) > 1 else 0.03
+batch = int(sys.argv[2]) if len(sys.argv) > 2 else (256 if on_tpu else 64)
+# 3200 real steps at any batch: dataset sized for 25 epochs x 128 steps
+# (or 50 x 64 at B=256)
+samples = batch * 128 if batch * 128 <= 16384 else 16384
+epochs = 3200 // (samples // batch)
 cfg = get_preset("cifar10-moco-v1").replace(
     arch="resnet18", cifar_stem=True, dataset="synthetic_texture",
-    image_size=32, batch_size=256, num_negatives=4096, embed_dim=128, lr=lr,
-    cos=True, epochs=50, steps_per_epoch=None,  # 16384/256 = 64 x 50 = 3200
+    image_size=32, batch_size=batch, num_negatives=4096, embed_dim=128,
+    lr=lr, cos=True, epochs=epochs, steps_per_epoch=None,
     knn_monitor=True, knn_bank_size=2048, num_classes=16,
-    ckpt_dir="", tb_dir="", print_freq=64, num_workers=1,
-    compute_dtype="bfloat16" if jax.default_backend() == "tpu" else "float32",
+    ckpt_dir="", tb_dir="", print_freq=128, num_workers=1,
+    compute_dtype="bfloat16" if on_tpu else "float32",
 )
-data = SyntheticTextureDataset(num_samples=16384, image_size=32, num_classes=16)
+data = SyntheticTextureDataset(num_samples=samples, image_size=32,
+                               num_classes=16)
 chance = 1.0 / data.num_classes
-print(json.dumps({"lr": lr, "backend": jax.default_backend(),
-                  "config": "horizon r4 (resnet18 32px K=4096, 16384-sample "
-                            "synthetic_texture/16-class, 3200 steps)",
+print(json.dumps({"lr": lr, "batch": batch, "backend": jax.default_backend(),
+                  "config": f"horizon r4 (resnet18 32px K=4096, B={batch}, "
+                            f"{samples}-sample synthetic_texture/16-class, "
+                            f"{epochs * (samples // batch)} steps)",
                   "chance_knn": chance,
                   "chance_loss": round(math.log(cfg.num_negatives + 1), 3)}),
       flush=True)
