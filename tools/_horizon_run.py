@@ -38,8 +38,8 @@ from moco_tpu.data.datasets import SyntheticTextureDataset
 from moco_tpu.train import train
 
 on_tpu = jax.default_backend() == "tpu"
-lr = float(sys.argv[1]) if len(sys.argv) > 1 else 0.03
-batch = int(sys.argv[2]) if len(sys.argv) > 2 else (256 if on_tpu else 64)
+lr = float(sys.argv[1]) if len(sys.argv) > 1 else 0.06
+batch = int(sys.argv[2]) if len(sys.argv) > 2 else (256 if on_tpu else 32)
 # 3200 real steps at any batch: dataset sized for 25 epochs x 128 steps
 # (or 50 x 64 at B=256)
 samples = batch * 128 if batch * 128 <= 16384 else 16384
