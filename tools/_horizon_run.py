@@ -71,7 +71,8 @@ baseline = metrics.get("knn_val_top1_untrained",
                        metrics.get("knn_train_top1_untrained", chance))
 final_knn = metrics.get("knn_val_top1", metrics.get("knn_train_top1"))
 final_loss = metrics.get("loss")
-record = {"untrained_knn": baseline, "final_knn_train_top1": final_knn,
+record = {"untrained_knn": baseline, "final_knn_top1": final_knn,
+          "split": "val" if "knn_val_top1" in metrics else "train-holdout",
           "final_loss": final_loss, "lr": lr, "steps": int(state.step),
           "wall_s": round(time.time() - t0, 1),
           "backend": jax.default_backend()}
