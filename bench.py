@@ -26,15 +26,30 @@ outer timeout — round 3's ladder (1500+900+1200 s) was killed at rc=124
 with nothing on stdout, erasing even the fact the TPU was down. Cheap-first
 design: the ~45 s CPU-proxy child runs FIRST and its record is printed
 IMMEDIATELY as a provisional line, so a number exists from minute one no
-matter when an external SIGKILL lands. The TPU attempt then runs with the
-remaining budget (one `MOCO_TPU_DISABLE_FUSED` retry when the failure
-looks like a compile error rather than an outage) and, on success, the
-upgraded record is printed as a NEW line — consumers take the LAST
-metric-bearing JSON line (the same convention `_run_child` applies to its
-children). A SIGTERM/SIGINT handler flushes the best-so-far record, so
-even a graceful kill mid-attempt yields the full evidence trail. Input and
-e2e child summaries are folded into the final record's "input"/"e2e" keys
-(VERDICT r3 #8) when the budget allows.
+matter when an external SIGKILL lands.
+
+The TPU success path (VERDICT r4 #2) is sized to actually SUCCEED, not
+just survive outage: a ~90 s LIVENESS PROBE child (`jax.devices()` only)
+decides whether a chip is reachable before any expensive attempt. Dead
+probe → the TPU attempt is skipped entirely (no 330 s hang) and the budget
+funds the CPU e2e proxy. Live probe → the step child gets EVERYTHING that
+remains minus a flush margin (`plan_tpu_attempt`, unit-tested cap
+arithmetic) — ~460 s on a fresh 600 s budget, vs r4's fixed 330 s — and
+the children enable a persistent XLA compilation cache
+(`moco_tpu.utils.cache`), so the first healthy contact pays the compile
+once and later runs spend the window measuring. One retry with
+`MOCO_TPU_DISABLE_PALLAS` runs only when the failure is FAST (compile
+error shape, e.g. a Mosaic rejection of the blur kernel), never on a
+hang; the shipping default has `fused_bn_conv` OFF until
+`tools/_fused_validate.py` passes on a chip, so the fused family is ruled
+out by default rather than by retry. On success the upgraded record is
+printed as a NEW line — consumers take the LAST metric-bearing JSON line
+(the same convention `_run_child` applies to its children). A
+SIGTERM/SIGINT handler flushes the best-so-far record, so even a graceful
+kill mid-attempt yields the full evidence trail. Input and e2e child
+summaries are folded into the final record's "input"/"e2e" keys (VERDICT
+r3 #8) when the budget allows; on a live-chip day the e2e slot upgrades
+to the real TPU measurement if the step child leaves >120 s.
 """
 
 from __future__ import annotations
@@ -54,6 +69,29 @@ BENCH_FALLBACK_METRICS = {
     "input": ("host_staging_throughput", "imgs/sec"),
     "e2e": ("moco_v2_r50_e2e_input_fed_throughput_per_chip", "imgs/sec/chip"),
 }
+
+# TPU attempt sizing (all unit-tested via plan_tpu_attempt):
+TPU_PROBE_CAP_S = 90.0    # jax import ~15 s + tunneled device init; a
+                          # healthy init is well under, a dead tunnel hangs
+                          # to the cap — 90 s is the cost of certainty
+FLUSH_MARGIN_S = 25.0     # kept back so the final record always prints
+MIN_TPU_ATTEMPT_S = 60.0  # below this a cold attempt cannot finish; skip
+
+
+def plan_tpu_attempt(remaining_s: float, probe_tpu_devices: float):
+    """Pure cap arithmetic for the TPU step attempt (VERDICT r4 #2c).
+
+    Returns (cap_s, reason): cap_s == 0 means skip. With a live probe the
+    attempt gets everything left minus the flush margin — the r4 design's
+    fixed 330 s cap + 140 s e2e reserve starved the success path; on a live
+    chip the headline measurement outranks the e2e reserve (which upgrades
+    to TPU opportunistically afterwards anyway)."""
+    if probe_tpu_devices <= 0:
+        return 0.0, "liveness probe found no TPU"
+    cap = remaining_s - FLUSH_MARGIN_S
+    if cap < MIN_TPU_ATTEMPT_S:
+        return 0.0, f"budget too thin for a TPU attempt ({remaining_s:.0f}s left)"
+    return cap, "live"
 
 
 def _run_child(mode: str, timeout_s: float, env_extra: dict | None = None):
@@ -182,34 +220,50 @@ def orchestrate(mode: str) -> None:
                                      "cores_per_8x1650imgs_chip_host")
                                     if k in inp}
 
-    # 3) the real target: TPU attempt with the remaining budget (the 140 s
-    #    reserve keeps the e2e summary's >120 s gate satisfiable after a
-    #    full-cap hang)
-    tpu = orch.run("tpu", mode, min(orch.remaining() - 140.0, 330.0), {})
-    if tpu is None:
-        timed_out = orch.last_timed_out
-        # a hang is an outage (retry would hang too) — only retry a hang
-        # when the budget is fat; a fast rc!=0 may be a Mosaic compile
-        # failure, which MOCO_TPU_DISABLE_FUSED is designed to rule out
-        if (not timed_out and orch.remaining() > 150.0) or \
-                (timed_out and orch.remaining() > 300.0):
-            time.sleep(10.0)
-            tpu = orch.run("tpu-retry", mode,
-                           min(orch.remaining() - 130.0, 330.0),
-                           {"MOCO_TPU_DISABLE_FUSED": "1",
-                            "MOCO_TPU_DISABLE_PALLAS": "1"})
+    # 3) liveness probe: a cheap `jax.devices()` child decides whether any
+    #    expensive attempt is worth making (VERDICT r4 #2b). A dead tunnel
+    #    hangs the probe to its 90 s cap — still 4x cheaper than hanging
+    #    the full attempt, and it buys the live path a far bigger window
+    probe = orch.run("tpu-probe", "probe", TPU_PROBE_CAP_S, {})
+    probe_devices = float(probe["value"]) if probe is not None else 0.0
+    cap, reason = plan_tpu_attempt(orch.remaining(), probe_devices)
+
+    # 4) the real target: TPU attempt with everything the probe left us
+    tpu = None
+    if cap > 0:
+        tpu = orch.run("tpu", mode, cap, {})
+        if tpu is None and not orch.last_timed_out:
+            # a fast rc!=0 may be a Pallas/Mosaic compile rejection —
+            # MOCO_TPU_DISABLE_PALLAS rules the custom-kernel path out
+            # (fused_bn_conv is already OFF by default, so DISABLE_FUSED
+            # would be a no-op here — ADVICE r4). A timeout on a LIVE chip
+            # means the compile didn't fit: retrying recompiles from
+            # scratch and times out again, so never retry a hang
+            retry_cap, _ = plan_tpu_attempt(orch.remaining() - 10.0,
+                                            probe_devices)
+            if retry_cap > 0:
+                time.sleep(10.0)
+                tpu = orch.run("tpu-retry", mode, retry_cap,
+                               {"MOCO_TPU_DISABLE_PALLAS": "1"})
+    else:
+        orch.errors.append(f"tpu: skipped ({reason})")
     if tpu is not None:
         orch.best = tpu
 
-    # 4) e2e summary: on TPU only if the TPU step just worked, else the CPU
-    #    proxy (the axon relay can hang — never probe it twice on a dead day)
-    if mode == "step" and orch.remaining() > 120.0:
-        e2e_env = None if tpu is not None else _CPU_ENV
-        e2e = orch.run("e2e", "e2e", orch.remaining() - 15.0, e2e_env)
-        if e2e is not None:
-            orch.extras["e2e"] = {k: e2e[k] for k in
-                                  ("metric", "value", "unit", "vs_baseline")
-                                  if k in e2e}
+    # 5) e2e summary: on TPU only if the TPU step just worked, else the CPU
+    #    proxy (the axon relay can hang — never probe it twice on a dead
+    #    day). On a live day the step child may rightfully have consumed
+    #    the reserve; the omission is recorded rather than starving step
+    if mode == "step":
+        if orch.remaining() > 120.0:
+            e2e_env = None if tpu is not None else _CPU_ENV
+            e2e = orch.run("e2e", "e2e", orch.remaining() - 15.0, e2e_env)
+            if e2e is not None:
+                orch.extras["e2e"] = {k: e2e[k] for k in
+                                      ("metric", "value", "unit", "vs_baseline")
+                                      if k in e2e}
+        else:
+            orch.errors.append("e2e: skipped, step attempt consumed the budget")
 
     orch.flush()
 
@@ -217,6 +271,23 @@ def orchestrate(mode: str) -> None:
 import numpy as np
 
 BASELINE_IMGS_PER_SEC_PER_CHIP = 168.0  # 8xV100 MoCo-v2, BASELINE.md
+
+
+def bench_probe():
+    """Liveness child: import jax + list devices, nothing else. Cheap on a
+    live day; the ONLY thing that hangs (to its small cap) on a dead one."""
+    import jax
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    print(json.dumps({
+        "metric": "tpu_liveness",
+        "value": float(sum(d.platform == "tpu" for d in devs)),
+        "unit": "devices",
+        "vs_baseline": 0.0,
+        "platform": devs[0].platform if devs else "none",
+        "init_s": round(time.perf_counter() - t0, 1),
+    }))
 
 
 def _make_jpeg_tree(root, n_images: int = 256, classes: int = 4, size=(500, 375)):
@@ -265,12 +336,23 @@ def bench_input():
         for stage in (256, 512):
             for threads in sorted({1, 2, 4, max(1, ncpu)}):
                 loader = NativeStagingLoader(stage, stage * 2, threads)
-                loader.load_batch(paths[:32])  # warm the pool
-                t0 = time.perf_counter()
+                # FULL-SIZE warm pass: thread-pool startup plus the first
+                # page-faulting allocation of the whole staging canvas
+                # (~400 MB at s512) must land outside the timed region —
+                # r4's single-shot timing put that one-time cost inside the
+                # first config measured, which is exactly the physically
+                # impossible "superlinear 1t→2t" artifact in BENCH_r04
+                # (VERDICT r4 weak #2 / #4)
                 _, _, failures = loader.load_batch(paths)
-                dt = time.perf_counter() - t0
                 assert failures == 0
-                rate = len(paths) / dt
+                reps = []
+                for _ in range(3):  # median-of-3: robust on a shared core
+                    t0 = time.perf_counter()
+                    _, _, failures = loader.load_batch(paths)
+                    dt = time.perf_counter() - t0
+                    assert failures == 0
+                    reps.append(len(paths) / dt)
+                rate = sorted(reps)[1]
                 detail[f"native_s{stage}_{threads}t"] = round(rate, 1)
                 if stage == 512:  # headline = the shipping default
                     best = max(best, rate)
@@ -379,7 +461,9 @@ def bench_e2e():
         assert np.isfinite(loss), f"non-finite e2e loss {loss}"
         return n
 
+    t_c = time.perf_counter()
     run_epoch(0, 2)  # compile + relay warmup
+    compile_warmup_s = time.perf_counter() - t_c
     t0 = time.perf_counter()
     n = run_epoch(1, steps)
     dt = time.perf_counter() - t0
@@ -393,6 +477,9 @@ def bench_e2e():
                 "value": round(per_chip, 2),
                 "unit": "imgs/sec/chip",
                 "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+                # evidence for sizing the TPU window (VERDICT r4 #2): how
+                # long compile+warmup actually took on THIS backend
+                "compile_warmup_s": round(compile_warmup_s, 1),
             }
         )
     )
@@ -420,8 +507,10 @@ def main():
         )
         steps, warmup = 20, 10
         if os.environ.get("MOCO_TPU_DISABLE_FUSED"):
-            # orchestrator retry path: rule out the fused Pallas tail as the
-            # failure cause
+            # manual knob (fused_bn_conv already defaults OFF pending
+            # tools/_fused_validate.py on a chip; the orchestrator's retry
+            # uses MOCO_TPU_DISABLE_PALLAS, which the aug's blur kernel
+            # reads — ADVICE r4)
             config = config.replace(fused_bn_conv=False)
     else:  # CPU fallback so the bench is runnable anywhere (tiny proxy)
         config = get_preset("imagenet-moco-v2").replace(
@@ -471,10 +560,12 @@ def main():
     # - the first executions after compile are relay-warmup (~seconds);
     #   steady state needs a generous warmup, then chained steps with one
     #   final sync amortize the ~70 ms relay round-trip.
+    t_c = time.perf_counter()
     for i in range(warmup):
         state, metrics = one_step(state, i)
     loss = float(metrics["loss"])
     assert np.isfinite(loss), f"non-finite warmup loss {loss}"
+    compile_warmup_s = time.perf_counter() - t_c
 
     best = float("inf")
     for r in range(2):  # best-of-2 rounds to dodge relay noise
@@ -499,6 +590,11 @@ def main():
                 "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
                 "fused_bn_conv": bool(config.fused_bn_conv),
                 "final_loss": round(loss, 4),
+                # measured cold/warm compile evidence (VERDICT r4 #2): on
+                # the first healthy contact this records how much of the
+                # window the compile ate; with the persistent cache warm it
+                # collapses to relay warmup
+                "compile_warmup_s": round(compile_warmup_s, 1),
             }
         )
     )
@@ -508,7 +604,8 @@ if __name__ == "__main__":
     import argparse
 
     parser = argparse.ArgumentParser()
-    parser.add_argument("--mode", choices=["step", "input", "e2e"], default="step")
+    parser.add_argument("--mode", choices=["step", "input", "e2e", "probe"],
+                        default="step")
     parser.add_argument(
         "--child", action="store_true",
         help="run the measurement in THIS process (no retry shell); the "
@@ -524,9 +621,17 @@ if __name__ == "__main__":
             from moco_tpu.parallel.mesh import force_cpu_devices
 
             force_cpu_devices(1)
-        if args.mode == "input":
+        if args.mode == "probe":
+            bench_probe()
+        elif args.mode == "input":
             bench_input()
-        elif args.mode == "e2e":
-            bench_e2e()
         else:
-            main()
+            # persistent compile cache (VERDICT r4 #2a): first healthy
+            # contact pays the compile, later children measure
+            from moco_tpu.utils.cache import enable_persistent_cache
+
+            enable_persistent_cache()
+            if args.mode == "e2e":
+                bench_e2e()
+            else:
+                main()

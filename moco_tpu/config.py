@@ -100,6 +100,10 @@ class PretrainConfig:
     export_path: str = ""             # write encoder_q (.safetensors/.npz) at end
     steps_per_epoch: int | None = None  # derived from dataset unless set
     knn_monitor: bool = False         # periodic kNN top-1 during pretrain
+    knn_every_epochs: int = 1         # monitor cadence (the eval costs ~160 s
+                                      # on the 1-core sandbox — long CPU runs
+                                      # thin it out; the final epoch always
+                                      # reports so gates see a fresh number)
     knn_bank_size: int = 4096         # monitor bank cap (train-subset size)
     num_classes: int = 1000           # dataset classes (kNN/eval only)
 

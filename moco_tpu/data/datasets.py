@@ -122,6 +122,10 @@ class SyntheticTextureDataset:
         self.num_classes = num_classes
         self.image_size = image_size
         self.seed = seed  # the monitor derives a held-out val seed from it
+        # recorded so the monitor's val split can mirror the train
+        # distribution exactly (non-default knobs included)
+        self.texture_amp = texture_amp
+        self.cast_strength = cast_strength
         g = np.random.RandomState(7777)
         tiles = g.rand(num_classes, 8, 8).astype(np.float32)
         tiles -= tiles.mean(axis=(1, 2), keepdims=True)  # zero-mean signal

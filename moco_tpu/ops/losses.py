@@ -69,11 +69,15 @@ def contrastive_accuracy(
     sort network is pure overhead for a 2-number metric. Tie semantics:
     strictly-greater counting credits the positive on exact float ties,
     matching torch `topk`'s first-occurrence behavior for equal values up
-    to column order."""
+    to column order. A NaN label logit compares False against everything
+    (n_better = 0), which would silently score as a top-k hit — the
+    finiteness AND below keeps a diverged row a miss, like the old top_k
+    formulation."""
     valid = labels >= 0  # eval paths pad ragged tails with label -1
     label_logit = jnp.take_along_axis(
         logits, jnp.maximum(labels, 0)[:, None], axis=-1
     )
+    valid &= jnp.isfinite(label_logit[:, 0])
     n_better = jnp.sum((logits > label_logit), axis=-1)  # [B]
     return tuple(100.0 * jnp.mean((n_better < k) & valid) for k in topk)
 

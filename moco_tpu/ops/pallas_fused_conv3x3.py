@@ -13,9 +13,11 @@ at the boundary); row masks zero the out-of-range contributions, and column
 shifts are masked at the W edges, reproducing the conv's zero padding
 exactly.
 
-Stride-2 conv2 (the first block of each stage) keeps the unfused path —
-strided halo tiling buys 4 of 16 blocks and is not worth the index
-complexity. `interpret=True` runs on CPU for the equivalence tests;
+Stride-2 conv2 (the first block of each stage) is fused too:
+`bn_relu_conv3x3_s2` below tiles the OUTPUT rows and reads the strided
+input halo through one widened ref (even/odd row decomposition, two edge
+masks), so all 16 R50 interior 3x3s go through the fused family.
+`interpret=True` runs on CPU for the equivalence tests;
 `tests/test_fused_conv3x3.py` also pins the TPU (Mosaic) lowering
 hardware-free via cross-platform export.
 

@@ -160,6 +160,10 @@ def _monitor_val_split(config, train_dataset):
             num_classes=config.num_classes,
             seed=getattr(train_dataset, "seed", 0) + 10007
             if hasattr(train_dataset, "seed") else 10007,
+            # mirror the train distribution's knobs: a val split drawn with
+            # different texture_amp/cast_strength would skew the monitor
+            texture_amp=getattr(train_dataset, "texture_amp", 0.4),
+            cast_strength=getattr(train_dataset, "cast_strength", 0.5),
         )
     return None
 
@@ -173,6 +177,10 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
     """
     if mesh is None:
         mesh = create_mesh()
+    if config.knn_monitor and config.knn_every_epochs < 1:
+        raise ValueError(
+            f"knn_every_epochs must be >= 1 (got {config.knn_every_epochs}); "
+            "disable the monitor with knn_monitor=False instead")
     if config.debug_nans:
         # numeric sanitizer (SURVEY §5.2): raise at the op that produced the
         # first NaN instead of training through garbage
@@ -361,7 +369,14 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
                 f"({throughput.imgs_per_sec_per_chip:.1f}/chip)",
                 flush=True,
             )
-            if config.knn_monitor:
+            # cadence: every knn_every_epochs, plus the run's final epoch
+            # (early `done` break included) so end-of-run gates always see a
+            # current number
+            if config.knn_monitor and (
+                (epoch + 1) % config.knn_every_epochs == 0
+                or epoch == config.epochs - 1
+                or done
+            ):
                 acc, is_val = knn_monitor(
                     config, feature_fn, state, dataset, mesh,
                     val_dataset=monitor_val,
@@ -437,6 +452,11 @@ def main(argv=None):
     config = get_preset(args.preset).replace(
         **collect_overrides(args, PretrainConfig)
     )
+    # persistent XLA compile cache: a restarted/resumed run (or the bench
+    # re-running this config) skips the multi-minute cold compile
+    from moco_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
     mesh = create_mesh(args.num_devices)
     print(f"config: {config}")
     print(f"mesh: {mesh}")

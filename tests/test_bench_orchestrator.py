@@ -44,17 +44,19 @@ INPUT = {"metric": "host_staging_throughput", "value": 482.1,
          "cores_per_8x1650imgs_chip_host": 28.5}
 E2E = {"metric": "moco_v2_r50_e2e_input_fed_throughput_per_chip",
        "value": 1500.0, "unit": "imgs/sec/chip", "vs_baseline": 8.9}
+PROBE = {"metric": "tpu_liveness", "value": 1.0, "unit": "devices",
+         "vs_baseline": 0.0, "platform": "tpu"}
 
 
 def _fake_child(clock, outcomes):
-    """outcomes: {mode or (mode, 'MOCO_TPU_DISABLE_FUSED'): result|None}.
+    """outcomes: {mode or (mode, 'pallas_off'): result|None}.
     Burns 45 s on success, the full granted timeout on failure/hang."""
     calls = []
 
     def fake(mode, timeout_s, env):
         env = env or {}
         calls.append((mode, timeout_s, dict(env)))
-        key = (mode, "fused_off") if env.get("MOCO_TPU_DISABLE_FUSED") else mode
+        key = (mode, "pallas_off") if env.get("MOCO_TPU_DISABLE_PALLAS") else mode
         forced_cpu = env.get("MOCO_TPU_FORCE_CPU")
         result = outcomes.get(key if key in outcomes else mode)
         if callable(result):
@@ -71,7 +73,8 @@ def _fake_child(clock, outcomes):
 def test_tpu_up_prints_provisional_then_upgraded_line(capsys):
     clock = FakeClock()
     fake, calls = _fake_child(clock, {"step": lambda cpu: PROXY if cpu else TPU,
-                                      "input": INPUT, "e2e": E2E})
+                                      "input": INPUT, "e2e": E2E,
+                                      "probe": PROBE})
     p1, p2 = _patch_clock(clock)
     with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
         bench.orchestrate("step")
@@ -91,7 +94,8 @@ def test_tpu_hang_keeps_proxy_and_stays_inside_budget(capsys):
     t_start = clock.t
     fake, calls = _fake_child(
         clock, {"step": lambda cpu: PROXY if cpu else None,
-                "input": INPUT, "e2e": lambda cpu: E2E if cpu else None})
+                "input": INPUT, "e2e": lambda cpu: E2E if cpu else None,
+                "probe": PROBE})
     p1, p2 = _patch_clock(clock)
     with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
         bench.orchestrate("step")
@@ -102,12 +106,68 @@ def test_tpu_hang_keeps_proxy_and_stays_inside_budget(capsys):
     # THE budget property (VERDICT r3 weak #1): wall time consumed by all
     # children + sleeps stays under the hard cap even when the TPU hangs
     assert clock.t - t_start <= bench.BENCH_TOTAL_BUDGET_S
-    # e2e after a TPU hang must force CPU (never probe a dead relay twice)
-    e2e_calls = [c for c in calls if c[0] == "e2e"]
-    assert all(c[2].get("MOCO_TPU_FORCE_CPU") for c in e2e_calls)
+    # the hung step attempt rightfully consumed the live-chip budget; e2e
+    # must neither run on the suspect relay nor eat into the flush margin
+    assert not [c for c in calls
+                if c[0] == "e2e" and not c[2].get("MOCO_TPU_FORCE_CPU")]
+    assert any("e2e: skipped" in e for e in out[-1]["degraded_from"])
 
 
-def test_fast_tpu_failure_retries_with_fused_disabled(capsys):
+def test_dead_probe_skips_tpu_attempt_entirely(capsys):
+    """A dead liveness probe means NO expensive TPU child runs (the r4
+    design burned 330 s hanging the full attempt on every dead day); the
+    freed budget funds the CPU e2e proxy instead."""
+    clock = FakeClock()
+    t_start = clock.t
+    fake, calls = _fake_child(
+        clock, {"step": lambda cpu: PROXY if cpu else None,
+                "input": INPUT, "e2e": lambda cpu: E2E if cpu else None,
+                "probe": None})  # probe hangs to its cap
+    p1, p2 = _patch_clock(clock)
+    with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
+        bench.orchestrate("step")
+    out = _lines(capsys)
+    # no step child ever ran without FORCE_CPU (c[0] is the child MODE —
+    # orch.run names like "tpu"/"tpu-retry" never reach _run_child)
+    assert not [c for c in calls
+                if c[0] == "step" and not c[2].get("MOCO_TPU_FORCE_CPU")]
+    assert any("liveness probe" in e for e in out[-1]["degraded_from"])
+    assert out[-1]["e2e"]["value"] == E2E["value"]
+    # dead day completes fast: proxy + input + probe cap + e2e
+    assert clock.t - t_start <= 45 + 45 + bench.TPU_PROBE_CAP_S + 45 + 1
+
+
+def test_live_probe_gives_step_the_remaining_budget(capsys):
+    """The success path's cap (VERDICT r4 weak #1): with a live probe the
+    step child gets remaining-minus-flush-margin, not a fixed 330 s."""
+    clock = FakeClock()
+    fake, calls = _fake_child(clock, {"step": lambda cpu: PROXY if cpu else TPU,
+                                      "input": INPUT, "e2e": E2E,
+                                      "probe": PROBE})
+    p1, p2 = _patch_clock(clock)
+    with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
+        bench.orchestrate("step")
+    tpu_calls = [c for c in calls
+                 if c[0] == "step" and not c[2].get("MOCO_TPU_FORCE_CPU")]
+    assert len(tpu_calls) == 1
+    # proxy 45 + input 45 + probe 45 burned; 465 left; minus 25 flush margin
+    assert tpu_calls[0][1] == 600.0 - 3 * 45.0 - bench.FLUSH_MARGIN_S
+
+
+def test_plan_tpu_attempt_cap_arithmetic():
+    # dead probe → skip, whatever the budget
+    cap, why = bench.plan_tpu_attempt(500.0, 0.0)
+    assert cap == 0.0 and "probe" in why
+    # live but too thin → skip
+    cap, why = bench.plan_tpu_attempt(
+        bench.MIN_TPU_ATTEMPT_S + bench.FLUSH_MARGIN_S - 1.0, 1.0)
+    assert cap == 0.0 and "thin" in why
+    # live and fat → everything minus the flush margin
+    cap, why = bench.plan_tpu_attempt(465.0, 1.0)
+    assert cap == 465.0 - bench.FLUSH_MARGIN_S and why == "live"
+
+
+def test_fast_tpu_failure_retries_with_pallas_disabled(capsys):
     clock = FakeClock()
 
     def fake(mode, timeout_s, env):
@@ -115,7 +175,10 @@ def test_fast_tpu_failure_retries_with_fused_disabled(capsys):
         if env.get("MOCO_TPU_FORCE_CPU"):
             clock.t += 45.0
             return dict(PROXY) if mode != "input" else dict(INPUT), None
-        if env.get("MOCO_TPU_DISABLE_FUSED"):
+        if mode == "probe":
+            clock.t += 20.0
+            return dict(PROBE), None
+        if env.get("MOCO_TPU_DISABLE_PALLAS"):
             clock.t += 60.0
             return dict(TPU), None
         clock.t += 30.0  # fast rc=1 (Mosaic compile error shape)

@@ -1,6 +1,7 @@
-"""Learning-dynamics-at-horizon run (VERDICT r1 #4 / r2 #3 / r3 #3):
-config-1-shaped MoCo-v1 pretrain for 3200 REAL steps with the per-epoch kNN
-monitor — on a dataset an UNTRAINED network cannot solve.
+"""Learning-dynamics-at-horizon run (VERDICT r1 #4 / r2 #3 / r3 #3 / r4 #1):
+config-1-shaped MoCo-v1 pretrain with the per-epoch kNN monitor — on a
+dataset an UNTRAINED network cannot solve — gated on the trained features
+beating the random-init baseline by a wide margin.
 
 r3's run used `SyntheticDataset`, whose classes random-init features
 separate at ~86% — a curve an untrained network matches is not a
@@ -12,16 +13,26 @@ baseline as an `Epoch [-1]` row (train.py knn_monitor), and this tool FAILS
 (exit 1) unless the final kNN beats that baseline by a wide margin and the
 loss visibly departs from the K+1-way chance level log(K+1) = 8.32.
 
-Usage: python tools/_horizon_run.py [lr] [batch] > runs/horizon_<backend>_r4.log
+Usage:
+    python tools/_horizon_run.py [--lr L] [--batch B] [--momentum M]
+        [--steps N] [--knn-every E] > runs/horizon_<backend>_r5.log
 
-Batch picks the wall-clock budget, not the science: the honest properties
-(resnet18@32, K=4096, 3200 REAL optimizer steps, chance-level untrained
-baseline, val-split monitor, the two gates) hold at any batch. On the TPU
-the config-1 batch 256 run is minutes; on the 1-core CPU sandbox a B=256
-step costs 10-26 s (measured 2026-07-30), so 3200 steps would be >10 h —
-B=64 (default off-TPU) fits the round while keeping 3200 real steps.
+Batch/steps pick the wall-clock budget, not the science: the honest
+properties (resnet18@32, K=4096, REAL optimizer steps, chance-level
+untrained baseline, val-split monitor, the two gates) hold at any scale.
+On the TPU the config-1 batch-256 3200-step run is minutes; on the 1-core
+CPU sandbox a step costs ~3-4 s (B=32/64, measured 2026-07-30), so the
+step budget is chosen to fit the round window.
+
+Operating point (r5): the r4 run (lr 0.06, m=0.999, B=32, 3200 steps)
+failed its gate with loss RISING 6.2->7.4 over the run — the queue/key
+encoder hardened faster than the query encoder learned. At 128-step
+epochs, m=0.999 gives the EMA a ~1000-step time constant (8 epochs of
+lag); m=0.99 (~100 steps) matches this scale, and lr follows the linear
+rule ~0.03*B/256 x a small-batch-safe factor. Defaults below come from the
+r5 micro-sweep (runs/horizon_sweep_r5.log).
 """
-import json, math, os, sys, time
+import argparse, json, math, os, sys, time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 if os.environ.get("MOCO_TPU_FORCE_CPU"):
@@ -38,27 +49,40 @@ from moco_tpu.data.datasets import SyntheticTextureDataset
 from moco_tpu.train import train
 
 on_tpu = jax.default_backend() == "tpu"
-lr = float(sys.argv[1]) if len(sys.argv) > 1 else 0.06
-batch = int(sys.argv[2]) if len(sys.argv) > 2 else (256 if on_tpu else 32)
-# 3200 real steps at any batch: dataset sized for 25 epochs x 128 steps
-# (or 50 x 64 at B=256)
-samples = batch * 128 if batch * 128 <= 16384 else 16384
-epochs = 3200 // (samples // batch)
+p = argparse.ArgumentParser()
+p.add_argument("--lr", type=float, default=0.03)
+p.add_argument("--batch", type=int, default=256 if on_tpu else 64)
+p.add_argument("--momentum", type=float, default=0.99)
+p.add_argument("--steps", type=int, default=3200)
+p.add_argument("--knn-every", type=int, default=1 if on_tpu else 2)
+p.add_argument("--samples", type=int, default=0,
+               help="dataset size (0 = batch*128 capped at 16384)")
+args = p.parse_args()
+lr, batch = args.lr, args.batch
+# at least one full batch per epoch: --samples below --batch would make
+# steps_per_epoch 0 and die on integer division
+samples = max(args.samples or min(batch * 128, 16384), batch)
+steps_per_epoch = samples // batch
+epochs = max(args.steps // steps_per_epoch, 1)
+total_steps = epochs * steps_per_epoch
 cfg = get_preset("cifar10-moco-v1").replace(
     arch="resnet18", cifar_stem=True, dataset="synthetic_texture",
     image_size=32, batch_size=batch, num_negatives=4096, embed_dim=128,
-    lr=lr, cos=True, epochs=epochs, steps_per_epoch=None,
-    knn_monitor=True, knn_bank_size=2048, num_classes=16,
-    ckpt_dir="", tb_dir="", print_freq=128, num_workers=1,
+    lr=lr, momentum_ema=args.momentum, cos=True, epochs=epochs,
+    steps_per_epoch=None,
+    knn_monitor=True, knn_every_epochs=args.knn_every,
+    knn_bank_size=2048, num_classes=16,
+    ckpt_dir="", tb_dir="", print_freq=steps_per_epoch, num_workers=1,
     compute_dtype="bfloat16" if on_tpu else "float32",
 )
 data = SyntheticTextureDataset(num_samples=samples, image_size=32,
                                num_classes=16)
 chance = 1.0 / data.num_classes
-print(json.dumps({"lr": lr, "batch": batch, "backend": jax.default_backend(),
-                  "config": f"horizon r4 (resnet18 32px K=4096, B={batch}, "
-                            f"{samples}-sample synthetic_texture/16-class, "
-                            f"{epochs * (samples // batch)} steps)",
+print(json.dumps({"lr": lr, "batch": batch, "momentum_ema": args.momentum,
+                  "backend": jax.default_backend(),
+                  "config": f"horizon r5 (resnet18 32px K=4096, B={batch}, "
+                            f"m={args.momentum}, {samples}-sample "
+                            f"synthetic_texture/16-class, {total_steps} steps)",
                   "chance_knn": chance,
                   "chance_loss": round(math.log(cfg.num_negatives + 1), 3)}),
       flush=True)
@@ -73,7 +97,8 @@ final_knn = metrics.get("knn_val_top1", metrics.get("knn_train_top1"))
 final_loss = metrics.get("loss")
 record = {"untrained_knn": baseline, "final_knn_top1": final_knn,
           "split": "val" if "knn_val_top1" in metrics else "train-holdout",
-          "final_loss": final_loss, "lr": lr, "steps": int(state.step),
+          "final_loss": final_loss, "lr": lr, "momentum_ema": args.momentum,
+          "batch": batch, "steps": int(state.step),
           "wall_s": round(time.time() - t0, 1),
           "backend": jax.default_backend()}
 print(json.dumps(record, default=float), flush=True)
