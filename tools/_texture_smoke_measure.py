@@ -1,0 +1,52 @@
+"""Derive the CI learning-detection threshold (VERDICT r4 #5).
+
+The horizon tool's methodology — untrained-baseline kNN vs trained kNN on
+`SyntheticTextureDataset` — lives in a manual tool; CI's smoke tests ran on
+the old separable dataset and could not detect a frozen encoder. This tool
+measures, over 3 seeds, what a CI-scale run (resnet_tiny, a few hundred
+steps) actually achieves, so `tests/test_smoke_train.py` can assert a
+MEASURED margin (threshold = roughly half the worst seed's delta, see the
+test's docstring for the final number).
+
+Usage: python tools/_texture_smoke_measure.py [steps] [lr]
+"""
+import json, os, sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from moco_tpu.parallel.mesh import force_cpu_devices
+
+force_cpu_devices(8)  # mirror the CI conftest topology
+from moco_tpu.config import get_preset
+from moco_tpu.data.datasets import SyntheticTextureDataset
+from moco_tpu.train import train
+
+steps = int(sys.argv[1]) if len(sys.argv) > 1 else 320
+lr = float(sys.argv[2]) if len(sys.argv) > 2 else 0.12
+rows = []
+for seed in (0, 1, 2):
+    spe = 32  # 1024 samples / B32
+    cfg = get_preset("cifar10-moco-v1").replace(
+        arch="resnet_tiny", cifar_stem=True, dataset="synthetic_texture",
+        image_size=32, batch_size=32, num_negatives=512, embed_dim=64,
+        lr=lr, momentum_ema=0.99, cos=True, epochs=max(steps // spe, 1),
+        knn_monitor=True, knn_every_epochs=max(steps // spe, 1),
+        knn_bank_size=768, num_classes=16, ckpt_dir="", tb_dir="",
+        print_freq=9999, seed=seed,
+    )
+    data = SyntheticTextureDataset(num_samples=1024, image_size=32,
+                                   num_classes=16, seed=seed)
+    state, metrics = train(cfg, dataset=data)
+    row = {
+        "seed": seed,
+        "untrained": round(metrics["knn_val_top1_untrained"], 4),
+        "trained": round(metrics["knn_val_top1"], 4),
+        "delta": round(metrics["knn_val_top1"]
+                       - metrics["knn_val_top1_untrained"], 4),
+        "loss": round(metrics["loss"], 3),
+        "steps": int(state.step),
+    }
+    rows.append(row)
+    print(json.dumps(row), flush=True)
+print(json.dumps({"lr": lr, "steps": steps,
+                  "worst_delta": min(r["delta"] for r in rows),
+                  "mean_delta": sum(r["delta"] for r in rows) / len(rows)}))

@@ -1,0 +1,61 @@
+#!/bin/bash
+# First-chip-contact runbook as ONE command (VERDICT r4 #3): when the TPU
+# tunnel comes back, run the full staged validation stack in priority
+# order without spending the window deciding what to run.
+#
+#   bash tools/first_chip.sh [runs_dir]
+#
+# Order (each stage timeboxed; a hang in one stage must not eat the rest):
+#   1. tools/_fused_validate.py  — numerics + fusedxremat A/B for all six
+#      Pallas kernel families; ITS DATA decides the fused_bn_conv default
+#   2. tools/_tpu_validate.py    — step semantics on the real chip
+#   3. tools/_horizon_run.py     — config-1 B=256 horizon (minutes on-chip)
+#   4. python bench.py           — the headline number, warm compile cache
+#
+# Every stage tees to $runs_dir/<stage>_tpu.log so a mid-run tunnel drop
+# still leaves committed evidence. The persistent compile cache
+# (.jax_cache/) carries compiles across stages and across reruns.
+set -u
+cd "$(dirname "$0")/.."
+RUNS="${1:-runs}"
+mkdir -p "$RUNS"
+overall_rc=0
+
+stage() { # name timeout_s cmd...
+  local name="$1" cap="$2"; shift 2
+  local log="$RUNS/${name}_tpu.log"
+  echo "=== [$name] (cap ${cap}s) $* -> $log"
+  # own process GROUP (setsid) + log-file redirect, no pipe: bench.py and
+  # the tools spawn children; killing only the direct python would leave
+  # orphans holding a tee pipe open and the stage would block past its cap
+  setsid "$@" > "$log" 2>&1 &
+  local pid=$! rc=0 waited=0
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep 5; waited=$((waited + 5))
+    if [ "$waited" -ge "$cap" ]; then
+      kill -TERM -- "-$pid" 2>/dev/null; sleep 10
+      kill -KILL -- "-$pid" 2>/dev/null
+      rc=124; break
+    fi
+  done
+  if [ "$rc" -ne 124 ]; then wait "$pid"; rc=$?; fi
+  tail -25 "$log"
+  echo "=== [$name] rc=$rc" | tee -a "$log"
+  [ "$rc" -ne 0 ] && overall_rc=1
+  return 0
+}
+
+# cheap liveness gate first: don't burn the stage caps on a dead tunnel
+timeout -k 15 120 python bench.py --child --mode probe > "$RUNS/probe_tpu.log" 2>&1
+cat "$RUNS/probe_tpu.log"
+if ! grep -q '"value": [1-9]' "$RUNS/probe_tpu.log"; then
+  echo "no live TPU (probe) — aborting first-chip stack" | tee -a "$RUNS/probe_tpu.log"
+  exit 2
+fi
+
+stage fused_validate 1200 python tools/_fused_validate.py
+stage tpu_validate    900 python tools/_tpu_validate.py
+stage horizon        1800 python tools/_horizon_run.py
+stage bench          1200 python bench.py
+echo "first_chip stack done (rc=$overall_rc); commit $RUNS/*_tpu.log"
+exit $overall_rc
