@@ -263,8 +263,15 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
         new_stats_q = lax.pmean(new_stats_q, DATA_AXIS)
         new_stats_k = lax.pmean(mut_k["batch_stats"], DATA_AXIS)
         acc1, acc5 = contrastive_accuracy(logits, labels)
+        # positive-pair cosine alignment (column 0 is q·k⁺/T): the cheapest
+        # honest learning signal — only aug-invariance optimization moves
+        # it, so a silently frozen encoder leaves it at its init value
+        # while loss/acc metrics can still look plausible against a
+        # frozen-feature queue (measured r5, runs/README.md)
+        pos_sim = jnp.mean(logits[:, 0]) * temperature
         metrics = lax.pmean(
-            {"loss": loss, "acc1": acc1, "acc5": acc5}, DATA_AXIS
+            {"loss": loss, "acc1": acc1, "acc5": acc5, "pos_sim": pos_sim},
+            DATA_AXIS,
         )
         return grads, k, new_stats_q, new_stats_k, metrics
 

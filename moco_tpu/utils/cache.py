@@ -34,9 +34,14 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
         import jax
 
         jax.config.update("jax_compilation_cache_dir", path)
-        # cache everything that took real compile time; the default 1 GB
-        # eviction policy keeps the dir bounded
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        return path
     except Exception:
         return None
+    try:
+        # cache everything that took real compile time; the default 1 GB
+        # eviction policy keeps the dir bounded. Optional: a jax build
+        # without this flag still has the cache ON via the dir above, so
+        # the return value must say enabled either way
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    return path

@@ -147,7 +147,13 @@ def build_v3_train_step(
         logits = jnp.einsum("nc,mc->nm", q1, k2_all, preferred_element_type=jnp.float32)
         labels = jnp.arange(q1.shape[0]) + lax.axis_index(DATA_AXIS) * q1.shape[0]
         acc1 = 100.0 * jnp.mean(jnp.argmax(logits, axis=-1) == labels)
-        metrics = lax.pmean({"loss": loss, "acc1": acc1}, DATA_AXIS)
+        # positive-pair alignment, same frozen-encoder detector as the
+        # v1/v2 step's pos_sim (q1/k2 are L2-normalized, so the row-dot is
+        # the cosine of the local positive pair)
+        pos_sim = jnp.mean(jnp.sum(q1 * k2, axis=-1))
+        metrics = lax.pmean(
+            {"loss": loss, "acc1": acc1, "pos_sim": pos_sim}, DATA_AXIS
+        )
         return grads, new_stats_q, new_stats_k, metrics
 
     region = jax.shard_map(

@@ -283,3 +283,13 @@ def test_run_child_reports_rc_and_tail():
     with mock.patch.object(bench.subprocess, "run", return_value=proc):
         parsed, err = bench._run_child("step", 10.0, {})
     assert parsed is None and "rc=1" in err and "BOOM" in err
+
+
+def test_probe_child_reports_no_tpu_on_cpu(capsys):
+    """The real probe child under the test backend (8 fake CPU devices):
+    metric shape is what the orchestrator keys on, and a CPU-only backend
+    must report value 0.0 (dead) so plan_tpu_attempt skips the attempt."""
+    bench.bench_probe()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "tpu_liveness" and rec["value"] == 0.0
+    assert rec["platform"] == "cpu" and rec["unit"] == "devices"

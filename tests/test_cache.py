@@ -1,0 +1,45 @@
+"""moco_tpu.utils.cache: the persistent XLA compile cache helper the bench
+children and train driver call (VERDICT r4 #2a). The helper must point JAX
+at the dir, honor the opt-out, and never raise."""
+
+import os
+
+import jax
+
+from moco_tpu.utils.cache import enable_persistent_cache
+
+
+_MIN_COMPILE_DEFAULT = jax.config.jax_persistent_cache_min_compile_time_secs
+
+
+def _reset():
+    jax.config.update("jax_compilation_cache_dir", None)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      _MIN_COMPILE_DEFAULT)
+
+
+def test_enable_points_jax_at_dir(tmp_path):
+    try:
+        d = str(tmp_path / "cache")
+        out = enable_persistent_cache(d)
+        assert out == d and os.path.isdir(d)
+        assert jax.config.jax_compilation_cache_dir == d
+    finally:
+        _reset()
+
+
+def test_env_dir_override(tmp_path, monkeypatch):
+    try:
+        d = str(tmp_path / "env_cache")
+        monkeypatch.setenv("MOCO_TPU_CACHE_DIR", d)
+        assert enable_persistent_cache() == d
+    finally:
+        _reset()
+
+
+def test_no_cache_opt_out(tmp_path, monkeypatch):
+    monkeypatch.setenv("MOCO_TPU_NO_CACHE", "1")
+    before = jax.config.jax_compilation_cache_dir
+    assert enable_persistent_cache(str(tmp_path / "x")) is None
+    assert jax.config.jax_compilation_cache_dir == before
+    assert not os.path.exists(tmp_path / "x")
