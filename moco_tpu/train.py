@@ -14,6 +14,8 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import time
 
 import jax
@@ -123,8 +125,6 @@ def _monitor_val_split(config, train_dataset):
     derives class ids from its own directory listing, so a partial or
     differently-listed `val/` would silently shift every label. Mismatched
     class maps fall back to the train hold-out with a visible notice."""
-    import os
-
     if config.dataset == "imagefolder":
         val_dir = os.path.join(config.data_dir, "val")
         if os.path.isdir(val_dir):
@@ -291,6 +291,10 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
     # same data — print it before any step so every horizon log carries it.
     # The monitor itself is a mesh-sharded (collective) computation, so
     # EVERY process must enter it; only the print/writer are main-gated
+    baseline_sidecar = (
+        os.path.join(config.ckpt_dir, "untrained_baseline.json")
+        if config.ckpt_dir else None
+    )
     if config.knn_monitor and start_epoch == 0 and global_step == 0:
         acc0, is_val0 = knn_monitor(
             config, feature_fn, state, dataset, mesh, val_dataset=monitor_val
@@ -307,6 +311,25 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
                 flush=True,
             )
             writer.write(0, {tag0: acc0})
+            if baseline_sidecar:
+                # persist next to the checkpoints: a resumed run can no
+                # longer MEASURE the untrained baseline (the restored
+                # encoder is trained), so it must inherit the recorded
+                # one — otherwise resume silently weakens any gate that
+                # compares against it
+                with open(baseline_sidecar, "w") as f:
+                    json.dump({tag0: float(acc0)}, f)
+    elif config.knn_monitor and global_step > 0 and baseline_sidecar and \
+            os.path.exists(baseline_sidecar):
+        with open(baseline_sidecar) as f:
+            baseline_metrics.update(json.load(f))
+        if is_main:
+            tag0, acc0 = next(iter(baseline_metrics.items()))
+            print(
+                f"Epoch [-1] kNN top-1 {100 * acc0:.2f}% (UNTRAINED "
+                f"baseline, restored from {baseline_sidecar})",
+                flush=True,
+            )
 
     try:
         for epoch in range(start_epoch, config.epochs):

@@ -57,6 +57,10 @@ p.add_argument("--steps", type=int, default=3200)
 p.add_argument("--knn-every", type=int, default=1 if on_tpu else 2)
 p.add_argument("--samples", type=int, default=0,
                help="dataset size (0 = batch*128 capped at 16384)")
+p.add_argument("--ckpt-dir", default="",
+               help="Orbax checkpoint dir ('' = off): makes the long CPU "
+                    "run preemption-proof — a killed run resumes with "
+                    "--resume auto semantics via the train driver")
 args = p.parse_args()
 lr, batch = args.lr, args.batch
 # at least one full batch per epoch: --samples below --batch would make
@@ -72,7 +76,9 @@ cfg = get_preset("cifar10-moco-v1").replace(
     steps_per_epoch=None,
     knn_monitor=True, knn_every_epochs=args.knn_every,
     knn_bank_size=2048, num_classes=16,
-    ckpt_dir="", tb_dir="", print_freq=steps_per_epoch, num_workers=1,
+    ckpt_dir=args.ckpt_dir, ckpt_every_epochs=4,
+    resume="auto" if args.ckpt_dir else "",
+    tb_dir="", print_freq=steps_per_epoch, num_workers=1,
     compute_dtype="bfloat16" if on_tpu else "float32",
 )
 data = SyntheticTextureDataset(num_samples=samples, image_size=32,
@@ -92,9 +98,23 @@ state, metrics = train(cfg, dataset=data)
 # seed, same fixed class tiles) — fall back to train-hold-out tags only if
 # that ever changes
 baseline = metrics.get("knn_val_top1_untrained",
-                       metrics.get("knn_train_top1_untrained", chance))
+                       metrics.get("knn_train_top1_untrained"))
 final_knn = metrics.get("knn_val_top1", metrics.get("knn_train_top1"))
 final_loss = metrics.get("loss")
+if int(state.step) >= total_steps and final_loss is None:
+    # resumed AFTER the final checkpoint: no step ran this invocation, so
+    # there is nothing fresh to gate — the original run's log carries the
+    # verdict. A distinct exit code, not a fake "gate failed"
+    print(json.dumps({"already_complete": True, "steps": int(state.step),
+                      "ckpt_dir": args.ckpt_dir}), flush=True)
+    sys.exit(3)
+if baseline is None:
+    # a resumed run could not restore the measured untrained baseline
+    # (missing sidecar): refusing is the honest outcome — falling back to
+    # chance would silently LOWER the gate
+    print("no untrained baseline available (resume without sidecar?) — "
+          "cannot gate honestly", flush=True)
+    sys.exit(4)
 record = {"untrained_knn": baseline, "final_knn_top1": final_knn,
           "split": "val" if "knn_val_top1" in metrics else "train-holdout",
           "final_loss": final_loss, "lr": lr, "momentum_ema": args.momentum,
