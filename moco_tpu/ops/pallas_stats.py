@@ -59,9 +59,21 @@ def _grad_sums_kernel(dy_ref, x_ref, mu_ref, r_ref, dsum_ref, dxh_ref):
 
 
 def _tile_rows(n: int, c: int) -> int:
-    """Rows per VMEM tile: target ~2 MB per streamed operand tile, keep the
-    row count a divisor-friendly power of two, and never exceed n."""
-    target = max(512, min(1 << 14, (2 << 20) // (2 * c)))
+    """Rows per VMEM tile: target ~1 MB per streamed operand tile, keep the
+    row count a divisor-friendly power of two, and never exceed n.
+
+    Why 1 MB (first-chip finding, r5): the grad-sums kernel keeps ~4 f32
+    tile-sized intermediates live on the Mosaic stack (dy, x̂, their
+    product, plus the cast of x); at the old 2 MB bf16 tile (t=16384,
+    c=64) that stack plus the double-buffered input windows totalled
+    19.87 MB against the 16 MB scoped-VMEM limit and the R50 step failed
+    to compile on the v5e (runs/tpu_validate_tpu.log, 2026-07-31). The
+    forward microbench only ever passed because its row count happened to
+    be indivisible by 16384. 1 MB tiles put the worst case ~10 MB. The
+    floor is 8 (the f32 sublane count), NOT a round 512: a 512-row floor
+    would recreate the same 1M-element tile at c=2048 (R50 layer4) that
+    blew the limit at c=64."""
+    target = max(8, min(1 << 13, (1 << 20) // (2 * c)))
     while n % target:
         target //= 2
         if target == 0:

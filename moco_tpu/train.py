@@ -317,14 +317,28 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
                 # encoder is trained), so it must inherit the recorded
                 # one — otherwise resume silently weakens any gate that
                 # compares against it
-                with open(baseline_sidecar, "w") as f:
+                # atomic: a preemption mid-write must not leave truncated
+                # JSON that bricks every later resume (the whole point of
+                # the sidecar is surviving preemption)
+                tmp = baseline_sidecar + ".tmp"
+                with open(tmp, "w") as f:
                     json.dump({tag0: float(acc0)}, f)
+                os.replace(tmp, baseline_sidecar)
     elif config.knn_monitor and global_step > 0 and baseline_sidecar and \
             os.path.exists(baseline_sidecar):
-        with open(baseline_sidecar) as f:
-            baseline_metrics.update(json.load(f))
-        if is_main:
-            tag0, acc0 = next(iter(baseline_metrics.items()))
+        try:
+            with open(baseline_sidecar) as f:
+                restored = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            restored = {}
+        if not isinstance(restored, dict):  # e.g. a file containing `null`
+            restored = {}
+        # empty/corrupt sidecar: leave baseline_metrics alone — the caller
+        # (tools/_horizon_run.py) refuses to gate without a baseline,
+        # which is the honest outcome
+        baseline_metrics.update(restored)
+        if is_main and restored:
+            tag0, acc0 = next(iter(restored.items()))
             print(
                 f"Epoch [-1] kNN top-1 {100 * acc0:.2f}% (UNTRAINED "
                 f"baseline, restored from {baseline_sidecar})",

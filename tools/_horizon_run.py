@@ -69,6 +69,58 @@ samples = max(args.samples or min(batch * 128, 16384), batch)
 steps_per_epoch = samples // batch
 epochs = max(args.steps // steps_per_epoch, 1)
 total_steps = epochs * steps_per_epoch
+
+if args.ckpt_dir:
+    # resume hygiene (review, r5): a resume MUST continue the same run —
+    # same step budget (the cosine schedule decays over `epochs`; different
+    # --steps would splice two schedules and gate a hybrid nobody ran),
+    # same batch/samples/lr/m. Persist the knobs on the fresh start and
+    # refuse a mismatched resume. Also fail FAST on a resume whose
+    # untrained-baseline sidecar is gone/corrupt: without it the gate
+    # cannot run, and discovering that AFTER the remaining epochs wastes
+    # the whole run (exit 4 semantics, just hours earlier).
+    run_args = {"steps": total_steps, "batch": batch, "samples": samples,
+                "lr": lr, "momentum_ema": args.momentum,
+                # numerics regime: a CPU-started f32 run must not silently
+                # resume on TPU in bf16 (or vice versa) — that would gate a
+                # spliced two-dtype run
+                "backend": jax.default_backend(),
+                "compute_dtype": "bfloat16" if on_tpu else "float32"}
+    args_path = os.path.join(args.ckpt_dir, "horizon_args.json")
+    baseline_path = os.path.join(args.ckpt_dir, "untrained_baseline.json")
+    has_ckpt = os.path.isdir(args.ckpt_dir) and any(
+        p_.isdigit() for p_ in os.listdir(args.ckpt_dir))
+    if has_ckpt:
+        try:
+            with open(args_path) as f:
+                prev = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            print(f"resume refused: {args_path} missing/corrupt — cannot "
+                  "prove the resumed flags match the original run", flush=True)
+            sys.exit(4)
+        if prev != run_args:
+            print(f"resume refused: flags changed {prev} -> {run_args}",
+                  flush=True)
+            sys.exit(4)
+        try:
+            with open(baseline_path) as f:
+                side = json.load(f)
+            ok = (isinstance(side, dict) and len(side) >= 1 and all(
+                k.startswith("knn_") and k.endswith("_untrained")
+                and isinstance(v, float) for k, v in side.items()))
+        except (OSError, json.JSONDecodeError):
+            ok = False
+        if not ok:
+            print(f"resume refused: {baseline_path} missing/corrupt — the "
+                  "gate would have nothing honest to compare against",
+                  flush=True)
+            sys.exit(4)
+    else:
+        os.makedirs(args.ckpt_dir, exist_ok=True)
+        tmp = args_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(run_args, f)
+        os.replace(tmp, args_path)
 cfg = get_preset("cifar10-moco-v1").replace(
     arch="resnet18", cifar_stem=True, dataset="synthetic_texture",
     image_size=32, batch_size=batch, num_negatives=4096, embed_dim=128,
