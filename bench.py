@@ -394,12 +394,10 @@ def bench_e2e():
     import jax
 
     from moco_tpu.config import get_preset
-    from moco_tpu.data.augment import build_two_crops_sharded, v2_aug_config
     from moco_tpu.data.datasets import ImageFolder
     from moco_tpu.data.loader import epoch_loader
     from moco_tpu.parallel.mesh import create_mesh
-    from moco_tpu.train_state import create_train_state
-    from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
+    from moco_tpu.utils.benchkit import build_v2_fused_step
 
     devices = jax.devices()
     n_chips = len(devices)
@@ -424,21 +422,7 @@ def bench_e2e():
         )
         steps = 3
     dataset = ImageFolder(root, **({"stage_size": stage_size} if stage_size else {}))
-    model = build_encoder(config)
-    tx, sched = build_optimizer(config, steps_per_epoch=1000)
-    state = create_train_state(
-        jax.random.key(0), model, tx,
-        (batch // n_chips, config.image_size, config.image_size, 3),
-        config.num_negatives, config.embed_dim,
-    )
-    step_fn = build_train_step(config, model, tx, mesh, 1000, sched)
-    from moco_tpu.data.augment import with_dtype
-    from moco_tpu.train_step import build_fused_step
-
-    two_crops = build_two_crops_sharded(
-        with_dtype(v2_aug_config(config.image_size), config.compute_dtype), mesh
-    )
-    fused = build_fused_step(step_fn, two_crops, jax.random.key(1))
+    fused, state = build_v2_fused_step(config, mesh)
 
     def run_epoch(epoch, max_steps):
         nonlocal state
@@ -487,13 +471,10 @@ def bench_e2e():
 
 def main():
     import jax
-    import jax.numpy as jnp
 
     from moco_tpu.config import get_preset
-    from moco_tpu.data.augment import build_two_crops_sharded, v2_aug_config
     from moco_tpu.parallel.mesh import create_mesh
-    from moco_tpu.train_state import create_train_state
-    from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
+    from moco_tpu.utils.benchkit import build_v2_fused_bench, time_fused_step
 
     devices = jax.devices()
     n_chips = len(devices)
@@ -520,62 +501,14 @@ def main():
         )
         steps, warmup = 5, 2
 
-    model = build_encoder(config)
-    tx, sched = build_optimizer(config, steps_per_epoch=1000)
-    state = create_train_state(
-        jax.random.key(0),
-        model,
-        tx,
-        (config.batch_size // n_chips, config.image_size, config.image_size, 3),
-        config.num_negatives,
-        config.embed_dim,
-    )
-    step_fn = build_train_step(config, model, tx, mesh, 1000, sched)
-
-    # aug in the compute dtype (bf16 on TPU) fused into ONE program with the
-    # step via the SAME build_fused_step the train driver uses
-    from moco_tpu.data.augment import with_dtype
-    from moco_tpu.data.datasets import full_extents
-    from moco_tpu.train_step import build_fused_step
-
-    aug_cfg = with_dtype(v2_aug_config(config.image_size), config.compute_dtype)
-    two_crops = build_two_crops_sharded(aug_cfg, mesh)
-    # one staged uint8 batch; re-augmented on device every step (two_crops),
-    # representing the steady-state input path with host decode amortized
-    stage = config.image_size + config.image_size // 8
-    rng = np.random.RandomState(0)
-    imgs_u8 = jnp.asarray(
-        rng.randint(0, 256, (config.batch_size, stage, stage, 3), dtype=np.uint8)
-    )
-    extents = full_extents(config.batch_size, stage, stage)
-    fused = build_fused_step(step_fn, two_crops, jax.random.key(1))
-
-    def one_step(state, i):
-        return fused(state, imgs_u8, extents, i)
-
-    # Timing notes (measured on the sandbox's tunneled v5e):
-    # - `block_until_ready` does NOT reliably synchronize on the experimental
-    #   axon PJRT relay — only a real device→host transfer does, so we sync
-    #   with float(loss).
-    # - the first executions after compile are relay-warmup (~seconds);
-    #   steady state needs a generous warmup, then chained steps with one
-    #   final sync amortize the ~70 ms relay round-trip.
-    t_c = time.perf_counter()
-    for i in range(warmup):
-        state, metrics = one_step(state, i)
-    loss = float(metrics["loss"])
-    assert np.isfinite(loss), f"non-finite warmup loss {loss}"
-    compile_warmup_s = time.perf_counter() - t_c
-
-    best = float("inf")
-    for r in range(2):  # best-of-2 rounds to dodge relay noise
-        t0 = time.perf_counter()
-        for i in range(steps):
-            state, metrics = one_step(state, (r + 1) * 1000 + i)
-        loss = float(metrics["loss"])
-        best = min(best, (time.perf_counter() - t0) / steps)
-    # a fast-but-wrong kernel must not publish a number
-    assert np.isfinite(loss), f"non-finite benchmark loss {loss}"
+    # aug in the compute dtype (bf16 on TPU) fused into ONE program with
+    # the step via the SAME build_fused_step the train driver uses; the
+    # assembly and timing semantics (relay-sync via float(loss), generous
+    # warmup, best-of-rounds, finite-loss asserts) live in benchkit, shared
+    # with tools/_tpu_validate.py and tools/_perf_ab.py
+    fused, state, imgs_u8, extents = build_v2_fused_bench(config, mesh)
+    best, compile_warmup_s, loss, state = time_fused_step(
+        fused, state, imgs_u8, extents, warmup=warmup, steps=steps)
 
     imgs_per_sec = config.batch_size / best
     per_chip = imgs_per_sec / n_chips

@@ -23,11 +23,19 @@ The reference's cuDNN BN kernels do these same fused reductions on GPU
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# Read ONCE at import: these kernels are traced inside jitted programs
+# (fast_bn inside the train step), so a mid-process env change could never
+# reach an already-compiled program — the jit cache does not key on it.
+# Import-time semantics make that staleness impossible instead of silent
+# (tools/_perf_ab.py sweeps the knob one subprocess per setting).
+_TILE_KIB = int(os.environ.get("MOCO_TPU_STATS_TILE_KIB", "0") or 0)
 
 
 def _sums_kernel(x_ref, sum_ref, sq_ref):
@@ -58,7 +66,7 @@ def _grad_sums_kernel(dy_ref, x_ref, mu_ref, r_ref, dsum_ref, dxh_ref):
     dxh_ref[...] += jnp.sum(dy * xh, axis=0, keepdims=True)
 
 
-def _tile_rows(n: int, c: int) -> int:
+def _tile_rows(n: int, c: int, kib: int | None = None) -> int:
     """Rows per VMEM tile: target ~1 MB per streamed operand tile, keep the
     row count a divisor-friendly power of two, and never exceed n.
 
@@ -72,8 +80,26 @@ def _tile_rows(n: int, c: int) -> int:
     be indivisible by 16384. 1 MB tiles put the worst case ~10 MB. The
     floor is 8 (the f32 sublane count), NOT a round 512: a 512-row floor
     would recreate the same 1M-element tile at c=2048 (R50 layer4) that
-    blew the limit at c=64."""
-    target = max(8, min(1 << 13, (1 << 20) // (2 * c)))
+    blew the limit at c=64.
+
+    MOCO_TPU_STATS_TILE_KIB (read at import, see _TILE_KIB above)
+    overrides the per-operand byte target (tools/_perf_ab.py sweeps it to
+    bound the tile size's share of the r5-vs-r2 step-time gap)."""
+    if kib is None:
+        kib = _TILE_KIB
+    budget = kib * 1024 if kib else (1 << 20)
+    # the row cap scales with the budget (fractionally — an integer >>20
+    # would floor a 1.5 MiB budget back to the default cap): a fixed 1<<13
+    # cap would make a 2 MiB override compile the SAME program as the
+    # default at c<=64 (R50 layer1 — exactly the pre-fix operating point
+    # the sweep exists to reach), silently voiding the A/B (review, r5)
+    row_cap = max(8, (1 << 13) * budget // (1 << 20))
+    target = max(8, min(row_cap, budget // (2 * c)))
+    # floor to a power of two BEFORE the divisibility loop: a factor-3
+    # target (e.g. a 768 KiB budget) would otherwise never divide a
+    # pow2-shaped n and halve all the way to degenerate 1-row tiles
+    # (review, r5)
+    target = 1 << (target.bit_length() - 1)
     while n % target:
         target //= 2
         if target == 0:

@@ -1,0 +1,110 @@
+"""Shared assembly + timing for the step-mode benchmark program.
+
+One definition of "the benchmark" — the fused aug+train-step program built
+the way the train driver builds it — used by `bench.py`'s step children,
+`tools/_tpu_validate.py`, and `tools/_perf_ab.py`. Before r5 each of those
+carried its own near-identical copy of this ~25-line block, which is
+exactly how an A/B tool silently stops timing the same program the bench
+publishes (review, r5). Every hyperparameter comes from the config; the
+callers only choose WHICH config.
+
+Timing semantics (measured on the sandbox's tunneled v5e, r2):
+- `block_until_ready` does NOT reliably synchronize on the experimental
+  axon PJRT relay — only a real device→host transfer does, so rounds sync
+  with `float(loss)`.
+- the first executions after compile are relay warmup (~seconds); steady
+  state needs a generous warmup, then chained steps with one final sync
+  amortize the ~70 ms relay round-trip.
+- best-of-rounds dodges relay noise; a non-finite loss must never publish
+  a number (asserted here, both at warmup and at the end).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_v2_fused_step(config, mesh, *, steps_per_epoch: int = 1000,
+                        state_seed: int = 0, fused_seed: int = 1):
+    """Assemble the fused aug+train-step program and its initial state for
+    `config`, exactly as the train driver does. Returns `(fused, state)`;
+    `fused(state, imgs_u8, extents, step)` is the one jitted program."""
+    from moco_tpu.data.augment import build_two_crops_sharded, v2_aug_config, with_dtype
+    from moco_tpu.train_state import create_train_state
+    from moco_tpu.train_step import (
+        build_encoder,
+        build_fused_step,
+        build_optimizer,
+        build_train_step,
+    )
+
+    n_chips = mesh.devices.size
+    model = build_encoder(config)
+    tx, sched = build_optimizer(config, steps_per_epoch=steps_per_epoch)
+    state = create_train_state(
+        jax.random.key(state_seed),
+        model,
+        tx,
+        (config.batch_size // n_chips, config.image_size, config.image_size, 3),
+        config.num_negatives,
+        config.embed_dim,
+    )
+    step_fn = build_train_step(config, model, tx, mesh, steps_per_epoch, sched)
+    aug_cfg = with_dtype(v2_aug_config(config.image_size), config.compute_dtype)
+    two_crops = build_two_crops_sharded(aug_cfg, mesh)
+    fused = build_fused_step(step_fn, two_crops, jax.random.key(fused_seed))
+    return fused, state
+
+
+def build_v2_fused_bench(config, mesh, *, steps_per_epoch: int = 1000,
+                         state_seed: int = 0, fused_seed: int = 1,
+                         data_seed: int = 0):
+    """`build_v2_fused_step` plus one staged uint8 batch at the native
+    staging shape (`image_size + image_size // 8`) — re-augmented on
+    device every step, representing the steady-state input path with host
+    decode amortized. Returns `(fused, state, imgs_u8, extents)`."""
+    from moco_tpu.data.datasets import full_extents
+
+    fused, state = build_v2_fused_step(
+        config, mesh, steps_per_epoch=steps_per_epoch,
+        state_seed=state_seed, fused_seed=fused_seed)
+    stage = config.image_size + config.image_size // 8
+    rng = np.random.RandomState(data_seed)
+    imgs_u8 = jnp.asarray(
+        rng.randint(0, 256, (config.batch_size, stage, stage, 3), dtype=np.uint8)
+    )
+    extents = full_extents(config.batch_size, stage, stage)
+    return fused, state, imgs_u8, extents
+
+
+def time_fused_step(fused, state, imgs_u8, extents, *, warmup: int,
+                    steps: int, rounds: int = 2):
+    """Warm up, then best-of-`rounds` timed runs of `steps` chained steps.
+
+    Returns `(best_s_per_step, compile_warmup_s, final_loss, state)`.
+    `compile_warmup_s` covers compile + relay warmup (the warmup loop,
+    including its sync); with a warm persistent cache it collapses to
+    relay warmup.
+    """
+    t_c = time.perf_counter()
+    metrics = None
+    for i in range(warmup):
+        state, metrics = fused(state, imgs_u8, extents, i)
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss), f"non-finite warmup loss {loss}"
+    compile_warmup_s = time.perf_counter() - t_c
+
+    best = float("inf")
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, metrics = fused(state, imgs_u8, extents, (r + 1) * 1000 + i)
+        loss = float(metrics["loss"])
+        best = min(best, (time.perf_counter() - t0) / steps)
+    # a fast-but-wrong kernel must not publish a number
+    assert np.isfinite(loss), f"non-finite benchmark loss {loss}"
+    return best, compile_warmup_s, loss, state

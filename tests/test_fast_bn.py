@@ -169,3 +169,41 @@ def test_bn_train_custom_vjp_matches_autodiff(dtype):
     np.testing.assert_allclose(np.asarray(gx, np.float32), np.asarray(gxa, np.float32), **tol)
     np.testing.assert_allclose(np.asarray(gs), np.asarray(ga["scale"]), rtol=1e-3, atol=1e-3)
     np.testing.assert_allclose(np.asarray(gb), np.asarray(ga["bias"]), rtol=1e-3, atol=1e-3)
+
+
+def test_tile_rows_vmem_budget_and_override():
+    """_tile_rows keeps every per-operand tile within the byte target (the
+    r5 on-chip VMEM finding: the grad-sums kernel holds ~4 f32 tile-sized
+    intermediates, so a 2 MB bf16 tile blew the 16 MB Mosaic scoped-VMEM
+    limit at c=64), divides n exactly, floors at the f32 sublane count,
+    and honors the tile-budget override (MOCO_TPU_STATS_TILE_KIB, read
+    once at import — a mid-process change could never reach an
+    already-jitted program, so the kib parameter is the testable seam)."""
+    from moco_tpu.ops.pallas_stats import _tile_rows
+
+    for n, c in [(128 * 56 * 56, 64), (128 * 7 * 7, 2048), (256, 512),
+                 (8, 64), (12, 256)]:
+        t = _tile_rows(n, c, kib=0)
+        assert n % t == 0 or t == n
+        # bf16 operand tile within the 1 MB default target (unless floored)
+        assert t * c * 2 <= (1 << 20) or t == 8 or t == n
+        assert t >= 1
+
+    # the floor is 8, not 512: c=2048 must not get a 1M-element tile
+    assert _tile_rows(128 * 7 * 7, 2048, kib=0) * 2048 * 2 <= (1 << 20)
+
+    base = _tile_rows(1 << 16, 64, kib=0)
+    # the row cap scales with the budget: a 2 MiB override must reach the
+    # pre-fix 16384-row tile at c=64, not clamp back to the default tile
+    assert _tile_rows(1 << 16, 64, kib=2048) == 2 * base
+    assert _tile_rows(1 << 16, 64, kib=256) == base // 4
+
+    # non-power-of-two budgets floor to a power-of-two tile instead of
+    # degenerating to 1-row tiles (factor-3 target vs pow2 n) or silently
+    # aliasing the default program
+    n = 128 * 56 * 56
+    assert _tile_rows(n, 64, kib=768) == 4096
+    assert _tile_rows(n, 128, kib=1536) == 4096
+    for kib in (3, 24, 768, 1536, 5000):
+        t = _tile_rows(n, 64, kib=kib)
+        assert t & (t - 1) == 0 and t >= 8, (kib, t)

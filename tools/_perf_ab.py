@@ -1,0 +1,74 @@
+"""On-chip step-time A/B for the r5-vs-r2 gap (README "open measurement
+question"): times the SAME fused MoCo-v2 R50 program as bench.py's step
+child under one knob setting per invocation, so the knob is applied before
+any moco_tpu import (fast_bn / augment read MOCO_TPU_DISABLE_PALLAS at
+trace time).
+
+    python tools/_perf_ab.py [--disable-pallas] [--batches 128,256]
+        [--stats-tile-kib N]   # override pallas_stats tile target
+
+Prints one JSON line per batch size:
+    {"ab": "...", "batch": B, "ms_per_step": T, "imgs_per_s": R}
+
+r2's 1780 imgs/s/chip operating point was ~72 ms/step at B=128; first
+contact (r5) measured 124 ms/step — this tool bisects whether the Pallas
+BN-stats kernels (whose tile budget the r5 VMEM fix cut 2 MB -> 1 MB for
+BOTH kernels, though only grad_sums needed it) account for the difference.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+p = argparse.ArgumentParser()
+p.add_argument("--disable-pallas", action="store_true")
+p.add_argument("--batches", default="128,256")
+p.add_argument("--stats-tile-kib", type=int, default=0,
+               help="override pallas_stats per-operand tile target (KiB)")
+p.add_argument("--label", default="")
+args = p.parse_args()
+
+if args.disable_pallas:
+    os.environ["MOCO_TPU_DISABLE_PALLAS"] = "1"
+if args.stats_tile_kib:
+    os.environ["MOCO_TPU_STATS_TILE_KIB"] = str(args.stats_tile_kib)
+
+from moco_tpu.utils.cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+
+from moco_tpu.config import get_preset
+from moco_tpu.parallel.mesh import create_mesh
+from moco_tpu.utils.benchkit import build_v2_fused_bench, time_fused_step
+
+label = args.label or ("no_pallas" if args.disable_pallas else
+                       f"tile{args.stats_tile_kib}k" if args.stats_tile_kib
+                       else "default")
+# echo the EFFECTIVE tile at two reference shapes (R50 layer1/layer4): a
+# budget that aliases the default program shows up here instead of being
+# reported as a distinct sweep point (review, r5)
+from moco_tpu.ops.pallas_stats import _tile_rows
+
+print(json.dumps({"ab": label, "backend": jax.default_backend(),
+                  "tile_rows_c64": _tile_rows(128 * 56 * 56, 64),
+                  "tile_rows_c2048": _tile_rows(128 * 7 * 7, 2048)}),
+      flush=True)
+
+for B in (int(b) for b in args.batches.split(",")):
+    mesh = create_mesh(1)
+    # IDENTICAL program to bench.py's step child: the assembly and timing
+    # live in moco_tpu.utils.benchkit, shared with bench.py and
+    # tools/_tpu_validate.py, so the A/B cannot drift from what the bench
+    # publishes (review, r5)
+    config = get_preset("imagenet-moco-v2").replace(batch_size=B, dataset="synthetic")
+    fused, state, imgs, ext = build_v2_fused_bench(config, mesh)
+    best, warm_s, _loss, state = time_fused_step(
+        fused, state, imgs, ext, warmup=10, steps=20, rounds=3)
+    print(json.dumps({"ab": label, "batch": B,
+                      "ms_per_step": round(best * 1e3, 2),
+                      "imgs_per_s": round(B / best, 1),
+                      "compile_warmup_s": round(warm_s, 1)}), flush=True)

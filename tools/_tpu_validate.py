@@ -39,37 +39,20 @@ def xla_sums(x):
 t2 = timeit(xla_sums, (x,))
 print(f"xla    sums        [{x.shape}]: {t2:.2f} ms = {nbytes/t2/1e6:.0f} GB/s")
 
-# --- 3) fused step timing ---
+# --- 3) fused step timing (assembly + timing shared via benchkit with
+#        bench.py's step child and tools/_perf_ab.py — review, r5) ---
 from moco_tpu.config import get_preset
-from moco_tpu.data.augment import build_two_crops_sharded, v2_aug_config, with_dtype
-from moco_tpu.data.datasets import full_extents
 from moco_tpu.parallel.mesh import create_mesh
-from moco_tpu.train_state import create_train_state
-from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step, build_fused_step
+from moco_tpu.utils.benchkit import build_v2_fused_bench, time_fused_step
 
 for B in (128, 256):
     mesh = create_mesh(1)
     config = get_preset("imagenet-moco-v2").replace(batch_size=B, dataset="synthetic")
-    model = build_encoder(config)
-    tx, sched = build_optimizer(config, 1000)
-    state = create_train_state(jax.random.key(0), model, tx, (B,224,224,3), 65536, 128)
-    step_fn = build_train_step(config, model, tx, mesh, 1000, sched)
-    aug = with_dtype(v2_aug_config(224), "bfloat16")
-    fused = build_fused_step(step_fn, build_two_crops_sharded(aug, mesh), jax.random.key(1))
-    rng = np.random.RandomState(0)
-    imgs = jnp.asarray(rng.randint(0,256,(B,252,252,3),dtype=np.uint8))
-    ext = full_extents(B,252,252)
-    st = state
+    fused, st, imgs, ext = build_v2_fused_bench(config, mesh)
     losses = []
-    for i in range(10):
+    for i in range(3):
         st, m = fused(st, imgs, ext, i)
-        if i < 3: losses.append(float(m["loss"]))
-    float(m["loss"])
-    best=1e9
-    for r in range(2):
-        t0=time.perf_counter()
-        for i in range(20):
-            st, m = fused(st, imgs, ext, 100*r+i)
-        float(m["loss"])
-        best=min(best,(time.perf_counter()-t0)/20)
+        losses.append(float(m["loss"]))
+    best, _warm, _loss, st = time_fused_step(
+        fused, st, imgs, ext, warmup=7, steps=20, rounds=2)
     print(f"B={B}: {best*1e3:.2f} ms/step -> {B/best:.1f} imgs/s  first losses {losses}")
