@@ -452,8 +452,13 @@ def _use_pallas_blur(cfg: AugConfig) -> bool:
         return True
     import os
 
+    # MOCO_TPU_DISABLE_PALLAS_BLUR: blur-only switch so tools/_perf_ab.py
+    # can attribute step time between the Pallas families (r5). "0" must
+    # mean off for the disable too — any-non-empty-is-truthy would turn
+    # the blur OFF for the natural inverse spelling (review, r5)
     return (jax.default_backend() == "tpu"
-            and not os.environ.get("MOCO_TPU_DISABLE_PALLAS"))
+            and not os.environ.get("MOCO_TPU_DISABLE_PALLAS")
+            and os.environ.get("MOCO_TPU_DISABLE_PALLAS_BLUR", "") in ("", "0"))
 
 
 def _sample_keys(key: jax.Array, start, n: int) -> jax.Array:

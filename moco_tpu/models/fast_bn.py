@@ -24,6 +24,16 @@ plain jnp in EXACTLY flax's op order — f32 stats, promote-to-dtype
 normalize — so CPU results (golden tests) are bit-identical to
 `nn.BatchNorm`. Interpret-mode Pallas can't run inside shard_map regions
 off-TPU in this jax version (same constraint as the Pallas blur).
+
+Status (r5 first contact): the Pallas REDUCTION kernels now default OFF
+even on TPU — the on-chip A/B measured them ~52 ms/step SLOWER than
+today's XLA reduce fusions at R50/B=128 (per-launch overhead across ~106
+pallas_calls; see `_use_pallas` and runs/perf_ab_*.log). They were a
+measured r2 win and remain available via MOCO_TPU_PALLAS_BN=1. The
+custom-VJP closed-form dx is gated SEPARATELY (`_use_custom_vjp`): on TPU
+it stays on (measured win over plain autodiff with jnp reductions
+inside); off-TPU it stays off so CPU goldens remain bit-identical to
+`nn.BatchNorm`.
 """
 
 from __future__ import annotations
@@ -39,13 +49,44 @@ from moco_tpu.ops.pallas_stats import channel_grad_sums, channel_sums
 
 
 def _use_pallas() -> bool:
-    # MOCO_TPU_DISABLE_PALLAS: global kill-switch so the bench orchestrator's
-    # retry can rule out EVERY custom Pallas kernel (not just the fused-conv
-    # family) as the cause of an on-chip failure
+    # Default OFF since r5 first contact — set by DATA, not caution: the
+    # tools/_perf_ab.py on-chip A/B (runs/perf_ab_*.log, 2026-07-31)
+    # measured the R50 step at 70.1 ms (BN kernels off, blur on) vs
+    # 122.3 ms with them on at B=128 — ~52 ms/step across the ~106
+    # pallas_call launches of a 53-BN network, i.e. per-launch overhead on
+    # the current Mosaic/relay toolchain, which no tile size fixes (the
+    # MOCO_TPU_STATS_TILE_KIB sweep left the microbench at ~20 GB/s
+    # against a ~494 GB/s roof). The kernels were a measured r2 win;
+    # today's XLA reduce fusions beat them. Numerics are identical either
+    # way (same math, f32 accumulation) — this is purely a perf default.
+    # MOCO_TPU_PALLAS_BN=1 opts back in; MOCO_TPU_DISABLE_PALLAS (the
+    # global kill-switch the bench retry uses) still wins over the opt-in.
     import os
 
     return (jax.default_backend() == "tpu"
+            # "0" must mean off — any-non-empty-is-truthy would turn the
+            # slow path ON for the natural inverse spelling (review, r5)
+            and os.environ.get("MOCO_TPU_PALLAS_BN", "") not in ("", "0")
             and not os.environ.get("MOCO_TPU_DISABLE_PALLAS"))
+
+
+def _use_custom_vjp() -> bool:
+    """Route train-mode BN (axis_name=None) through `_bn_train`'s
+    custom-VJP closed-form dx, with `_use_pallas()` separately choosing
+    pallas-vs-jnp REDUCTIONS inside. Keeping this independent of the
+    kernel opt-in lets the closed-form dx ship (or not) on its own merit:
+    the r5 on-chip A/B measured jnp-reductions+custom-VJP at 71.4 ms/step
+    vs 71.8-72.0 for plain autodiff at R50/B=128 (149.5 vs 151.9 at
+    B=256; runs/perf_ab_bn_vjp.log vs perf_ab_bn_autodiff.log) — a small,
+    repeatable win, so it stays ON for TPU. Off-TPU the plain jnp
+    autodiff path is kept for bit-identical CPU goldens (the closed form
+    differs from flax autodiff by ~1 ulp). MOCO_TPU_BN_VJP=1/0 forces."""
+    import os
+
+    v = os.environ.get("MOCO_TPU_BN_VJP", "")
+    if v:
+        return v != "0"
+    return jax.default_backend() == "tpu"
 
 
 def _batch_stats(x, use_pallas):
@@ -143,8 +184,9 @@ class FastBatchNorm(nn.Module):
             return _normalize(
                 x, ra_mean.value, ra_var.value, scale, bias, self.epsilon, self.dtype
             )
-        if self.axis_name is None and _use_pallas():
-            # TPU: Pallas streaming reductions under the custom VJP
+        if self.axis_name is None and (_use_pallas() or _use_custom_vjp()):
+            # TPU: closed-form custom VJP; reductions are pallas or jnp
+            # per _use_pallas() inside _bn_train
             y, mean, var = _bn_train(x, scale, bias, self.epsilon, self.dtype)
         else:
             # off-TPU / SyncBN: plain jnp in flax's exact op order, autodiff

@@ -30,7 +30,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from moco_tpu.models.fast_bn import _batch_stats, _normalize, _use_pallas
+from moco_tpu.models.fast_bn import _batch_stats, _normalize
 from moco_tpu.ops.pallas_fused_conv import bn_relu_matmul, bn_relu_matmul_dw
 from moco_tpu.ops.pallas_fused_conv3x3 import (
     bn_relu_conv3x3,
@@ -38,6 +38,21 @@ from moco_tpu.ops.pallas_fused_conv3x3 import (
     conv3x3_dw,
 )
 from moco_tpu.ops.pallas_stats import channel_grad_sums
+
+
+def _use_pallas() -> bool:
+    """Gate for the fused-conv kernel family — a block only reaches this
+    module when `config.fused_bn_conv=True` routed it here, so this is
+    deliberately INDEPENDENT of fast_bn's BN-stats opt-in
+    (MOCO_TPU_PALLAS_BN): the r5 A/B that turned the stats kernels off by
+    default must not silently disable the separately-validated fused
+    family's documented config switch (review, r5). The global
+    MOCO_TPU_DISABLE_PALLAS kill-switch (bench retry) still applies; off
+    TPU the blocks fall back to `_plain_apply`."""
+    import os
+
+    return (jax.default_backend() == "tpu"
+            and not os.environ.get("MOCO_TPU_DISABLE_PALLAS"))
 
 
 def norm_train_flag(norm) -> bool:

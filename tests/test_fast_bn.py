@@ -207,3 +207,49 @@ def test_tile_rows_vmem_budget_and_override():
     for kib in (3, 24, 768, 1536, 5000):
         t = _tile_rows(n, 64, kib=kib)
         assert t & (t - 1) == 0 and t >= 8, (kib, t)
+
+
+def test_pallas_gates_are_decoupled(monkeypatch):
+    """fast_bn's BN-stats kernels default OFF on TPU (r5 on-chip A/B:
+    ~52 ms/step launch overhead) behind the MOCO_TPU_PALLAS_BN opt-in,
+    while fused_block's separately-validated family stays reachable via
+    its config switch — flipping one default must not silently gate the
+    other (review, r5). "0" must mean off for the opt-in."""
+    import unittest.mock as mock
+
+    import moco_tpu.models.fast_bn as fbn
+    import moco_tpu.models.fused_block as fb
+
+    with mock.patch.object(jax, "default_backend", lambda: "tpu"):
+        monkeypatch.delenv("MOCO_TPU_PALLAS_BN", raising=False)
+        monkeypatch.delenv("MOCO_TPU_DISABLE_PALLAS", raising=False)
+        assert not fbn._use_pallas()      # opt-in, default off
+        assert fb._use_pallas()           # fused family: config gates it
+
+        monkeypatch.setenv("MOCO_TPU_PALLAS_BN", "1")
+        assert fbn._use_pallas()
+        monkeypatch.setenv("MOCO_TPU_PALLAS_BN", "0")
+        assert not fbn._use_pallas()      # "0" is off, not truthy-on
+
+        monkeypatch.setenv("MOCO_TPU_PALLAS_BN", "1")
+        monkeypatch.setenv("MOCO_TPU_DISABLE_PALLAS", "1")
+        assert not fbn._use_pallas()      # global kill-switch wins
+        assert not fb._use_pallas()
+
+
+def test_custom_vjp_gate(monkeypatch):
+    """_use_custom_vjp: ON for TPU (measured win, closed-form dx), OFF
+    elsewhere (CPU goldens pin plain autodiff), MOCO_TPU_BN_VJP forces
+    either way and "0" means off."""
+    import unittest.mock as mock
+
+    import moco_tpu.models.fast_bn as fbn
+
+    monkeypatch.delenv("MOCO_TPU_BN_VJP", raising=False)
+    assert not fbn._use_custom_vjp()  # cpu backend here
+    with mock.patch.object(jax, "default_backend", lambda: "tpu"):
+        assert fbn._use_custom_vjp()
+        monkeypatch.setenv("MOCO_TPU_BN_VJP", "0")
+        assert not fbn._use_custom_vjp()
+    monkeypatch.setenv("MOCO_TPU_BN_VJP", "1")
+    assert fbn._use_custom_vjp()      # forced on even off-TPU

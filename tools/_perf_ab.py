@@ -24,14 +24,28 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 p = argparse.ArgumentParser()
 p.add_argument("--disable-pallas", action="store_true")
+p.add_argument("--pallas-bn", action="store_true",
+               help="opt the fast_bn stats kernels back IN (default OFF "
+                    "since the r5 A/B: ~52 ms/step launch overhead)")
+p.add_argument("--disable-pallas-blur", action="store_true",
+               help="disable only the aug blur stencil kernel")
 p.add_argument("--batches", default="128,256")
 p.add_argument("--stats-tile-kib", type=int, default=0,
                help="override pallas_stats per-operand tile target (KiB)")
 p.add_argument("--label", default="")
 args = p.parse_args()
 
+if args.stats_tile_kib and not (args.pallas_bn or args.disable_pallas):
+    # the tile knob tunes the BN-stats kernels, which default OFF since
+    # the r5 A/B — without the opt-in the sweep would time a program with
+    # zero pallas_stats calls under a 'tileNk' label (review, r5)
+    args.pallas_bn = True
 if args.disable_pallas:
     os.environ["MOCO_TPU_DISABLE_PALLAS"] = "1"
+if args.pallas_bn:
+    os.environ["MOCO_TPU_PALLAS_BN"] = "1"
+if args.disable_pallas_blur:
+    os.environ["MOCO_TPU_DISABLE_PALLAS_BLUR"] = "1"
 if args.stats_tile_kib:
     os.environ["MOCO_TPU_STATS_TILE_KIB"] = str(args.stats_tile_kib)
 
@@ -45,9 +59,19 @@ from moco_tpu.config import get_preset
 from moco_tpu.parallel.mesh import create_mesh
 from moco_tpu.utils.benchkit import build_v2_fused_bench, time_fused_step
 
-label = args.label or ("no_pallas" if args.disable_pallas else
-                       f"tile{args.stats_tile_kib}k" if args.stats_tile_kib
-                       else "default")
+# labels COMPOSE: every active knob appears, so a combined invocation
+# (e.g. --pallas-bn --stats-tile-kib 512) cannot log ambiguously
+# (review, r5)
+parts = []
+if args.disable_pallas:
+    parts.append("no_pallas")
+if args.pallas_bn:
+    parts.append("pallas_bn_on")
+if args.disable_pallas_blur:
+    parts.append("no_pallas_blur")
+if args.stats_tile_kib:
+    parts.append(f"tile{args.stats_tile_kib}k")
+label = args.label or ("+".join(parts) if parts else "default")
 # echo the EFFECTIVE tile at two reference shapes (R50 layer1/layer4): a
 # budget that aliases the default program shows up here instead of being
 # reported as a distinct sweep point (review, r5)
