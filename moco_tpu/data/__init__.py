@@ -2,6 +2,7 @@ from moco_tpu.data.augment import (
     AugConfig,
     augment_batch,
     build_two_crops_sharded,
+    aug_config_for,
     eval_aug_config,
     two_crops,
     v1_aug_config,
@@ -15,6 +16,7 @@ __all__ = [
     "AugConfig",
     "augment_batch",
     "build_two_crops_sharded",
+    "aug_config_for",
     "eval_aug_config",
     "two_crops",
     "v1_aug_config",

@@ -76,6 +76,19 @@ def v2_aug_config(out_size: int = 224) -> AugConfig:
     return AugConfig(out_size=out_size, hue=0.1, jitter_prob=0.8, blur_prob=0.5)
 
 
+def aug_config_for(config):
+    """The ONE variant→aug-recipe selection, shared by the train driver and
+    benchkit so a benchmark can never time an aug stack the driver would
+    not run (review, r5): v3 → asymmetric pair (crop_min is the repo's
+    --crop-min knob), v2/aug_plus → blur+hue stack, else the v1 recipe."""
+    if config.variant == "v3":
+        return v3_aug_configs(config.image_size,
+                              min_scale=config.crop_min or 0.08)
+    if config.aug_plus:
+        return v2_aug_config(config.image_size)
+    return v1_aug_config(config.image_size)
+
+
 def v3_aug_configs(
     out_size: int = 224, min_scale: float = 0.08
 ) -> tuple[AugConfig, AugConfig]:
@@ -448,17 +461,14 @@ def _use_pallas_blur(cfg: AugConfig) -> bool:
         # commutes with linear ops — solarize is nonlinear, so v3's
         # solarizing view keeps the in-pipeline (portable) blur
         return False
-    if cfg.pallas_blur == "on":
-        return True
-    import os
+    from moco_tpu.utils.envflags import env_flag
 
     # MOCO_TPU_DISABLE_PALLAS_BLUR: blur-only switch so tools/_perf_ab.py
-    # can attribute step time between the Pallas families (r5). "0" must
-    # mean off for the disable too — any-non-empty-is-truthy would turn
-    # the blur OFF for the natural inverse spelling (review, r5)
+    # can attribute step time between the Pallas families (r5); uniform
+    # "0"-means-off parsing via env_flag (review, r5)
     return (jax.default_backend() == "tpu"
-            and not os.environ.get("MOCO_TPU_DISABLE_PALLAS")
-            and os.environ.get("MOCO_TPU_DISABLE_PALLAS_BLUR", "") in ("", "0"))
+            and not env_flag("MOCO_TPU_DISABLE_PALLAS")
+            and not env_flag("MOCO_TPU_DISABLE_PALLAS_BLUR"))
 
 
 def _sample_keys(key: jax.Array, start, n: int) -> jax.Array:

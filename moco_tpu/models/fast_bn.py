@@ -61,13 +61,11 @@ def _use_pallas() -> bool:
     # way (same math, f32 accumulation) — this is purely a perf default.
     # MOCO_TPU_PALLAS_BN=1 opts back in; MOCO_TPU_DISABLE_PALLAS (the
     # global kill-switch the bench retry uses) still wins over the opt-in.
-    import os
+    from moco_tpu.utils.envflags import env_flag
 
     return (jax.default_backend() == "tpu"
-            # "0" must mean off — any-non-empty-is-truthy would turn the
-            # slow path ON for the natural inverse spelling (review, r5)
-            and os.environ.get("MOCO_TPU_PALLAS_BN", "") not in ("", "0")
-            and not os.environ.get("MOCO_TPU_DISABLE_PALLAS"))
+            and env_flag("MOCO_TPU_PALLAS_BN")
+            and not env_flag("MOCO_TPU_DISABLE_PALLAS"))
 
 
 def _use_custom_vjp() -> bool:
@@ -80,7 +78,11 @@ def _use_custom_vjp() -> bool:
     B=256; runs/perf_ab_bn_vjp.log vs perf_ab_bn_autodiff.log) — a small,
     repeatable win, so it stays ON for TPU. Off-TPU the plain jnp
     autodiff path is kept for bit-identical CPU goldens (the closed form
-    differs from flax autodiff by ~1 ulp). MOCO_TPU_BN_VJP=1/0 forces."""
+    differs from flax autodiff by ~1 ulp). MOCO_TPU_BN_VJP=1/0 forces —
+    EXCEPT that MOCO_TPU_PALLAS_BN=1 implies the custom-VJP path
+    regardless (the Pallas reduction kernels live inside `_bn_train`;
+    "pallas reductions + plain autodiff" is not a constructible program,
+    so BN_VJP=0 cannot carve it out — review, r5)."""
     import os
 
     v = os.environ.get("MOCO_TPU_BN_VJP", "")

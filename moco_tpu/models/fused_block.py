@@ -49,10 +49,10 @@ def _use_pallas() -> bool:
     family's documented config switch (review, r5). The global
     MOCO_TPU_DISABLE_PALLAS kill-switch (bench retry) still applies; off
     TPU the blocks fall back to `_plain_apply`."""
-    import os
+    from moco_tpu.utils.envflags import env_flag
 
     return (jax.default_backend() == "tpu"
-            and not os.environ.get("MOCO_TPU_DISABLE_PALLAS"))
+            and not env_flag("MOCO_TPU_DISABLE_PALLAS"))
 
 
 def norm_train_flag(norm) -> bool:

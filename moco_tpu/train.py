@@ -25,12 +25,10 @@ import numpy as np
 from moco_tpu.checkpoint import checkpoint_manager, maybe_resume, save_checkpoint
 from moco_tpu.config import PRESETS, PretrainConfig, get_preset
 from moco_tpu.data import (
+    aug_config_for,
     build_dataset,
     build_two_crops_sharded,
     epoch_loader,
-    v1_aug_config,
-    v2_aug_config,
-    v3_aug_configs,
 )
 from moco_tpu.ops.knn import knn_accuracy
 from moco_tpu.parallel.mesh import create_mesh, local_batch_size
@@ -243,15 +241,7 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
 
         state = state.replace(opt_state=shard_opt_state(state.opt_state, mesh))
 
-    if config.variant == "v3":
-        # asymmetric view pair; crop_min is the repo's --crop-min knob
-        aug_cfg = v3_aug_configs(
-            config.image_size, min_scale=config.crop_min or 0.08
-        )
-    elif config.aug_plus:
-        aug_cfg = v2_aug_config(config.image_size)
-    else:
-        aug_cfg = v1_aug_config(config.image_size)
+    aug_cfg = aug_config_for(config)
     # image pipeline in the model's compute dtype: bf16 halves the aug's HBM
     # traffic on TPU (the encoder casts to bf16 immediately anyway)
     from moco_tpu.data.augment import with_dtype

@@ -31,9 +31,15 @@ import numpy as np
 def build_v2_fused_step(config, mesh, *, steps_per_epoch: int = 1000,
                         state_seed: int = 0, fused_seed: int = 1):
     """Assemble the fused aug+train-step program and its initial state for
-    `config`, exactly as the train driver does. Returns `(fused, state)`;
-    `fused(state, imgs_u8, extents, step)` is the one jitted program."""
-    from moco_tpu.data.augment import build_two_crops_sharded, v2_aug_config, with_dtype
+    `config`, exactly as the train driver does (`config.variant` selects
+    the v1/v2 queue step or the v3 queue-free step and the matching aug
+    pair). Returns `(fused, state)`; `fused(state, imgs_u8, extents,
+    step)` is the one jitted program."""
+    from moco_tpu.data.augment import (
+        aug_config_for,
+        build_two_crops_sharded,
+        with_dtype,
+    )
     from moco_tpu.train_state import create_train_state
     from moco_tpu.train_step import (
         build_encoder,
@@ -45,16 +51,26 @@ def build_v2_fused_step(config, mesh, *, steps_per_epoch: int = 1000,
     n_chips = mesh.devices.size
     model = build_encoder(config)
     tx, sched = build_optimizer(config, steps_per_epoch=steps_per_epoch)
-    state = create_train_state(
-        jax.random.key(state_seed),
-        model,
-        tx,
-        (config.batch_size // n_chips, config.image_size, config.image_size, 3),
-        config.num_negatives,
-        config.embed_dim,
-    )
+    local_shape = (config.batch_size // n_chips,
+                   config.image_size, config.image_size, 3)
+    if config.variant == "v3":
+        from moco_tpu.v3_step import create_v3_train_state
+
+        state = create_v3_train_state(
+            jax.random.key(state_seed), model, tx, local_shape)
+    else:
+        state = create_train_state(
+            jax.random.key(state_seed),
+            model,
+            tx,
+            local_shape,
+            config.num_negatives,
+            config.embed_dim,
+        )
     step_fn = build_train_step(config, model, tx, mesh, steps_per_epoch, sched)
-    aug_cfg = with_dtype(v2_aug_config(config.image_size), config.compute_dtype)
+    # the SAME variant->aug selection as the train driver (v1 presets get
+    # the v1 recipe, not a silently-substituted v2 stack — review, r5)
+    aug_cfg = with_dtype(aug_config_for(config), config.compute_dtype)
     two_crops = build_two_crops_sharded(aug_cfg, mesh)
     fused = build_fused_step(step_fn, two_crops, jax.random.key(fused_seed))
     return fused, state

@@ -1,0 +1,17 @@
+"""Uniform environment-flag parsing for the Pallas-family switches.
+
+`env_flag(name)` is True iff the variable is set to anything other than
+"" or "0" — so "0" means OFF for every switch, including the DISABLE_*
+spellings where =0 reads "not disabled". Before this helper the three
+gates (fast_bn, fused_block, augment blur) each hand-rolled the check and
+a truthy-string `os.environ.get` made MOCO_TPU_DISABLE_PALLAS=0 silently
+kill every kernel family (review, r5).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_flag(name: str) -> bool:
+    return os.environ.get(name, "") not in ("", "0")

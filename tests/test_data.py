@@ -476,3 +476,24 @@ def test_v3_asymmetric_two_crops(mesh8):
     assert q.shape == k.shape == (16, 16, 16, 3)
     assert np.isfinite(np.asarray(q)).all() and np.isfinite(np.asarray(k)).all()
     assert not np.allclose(np.asarray(q), np.asarray(k))
+
+
+def test_aug_config_for_matches_variant():
+    """The shared variant->recipe selector (train driver AND benchkit —
+    review, r5): v1 presets must get the v1 recipe (grayscale-first, no
+    blur), not a silently-substituted v2 stack; v3 gets the asymmetric
+    pair with crop_min plumbed."""
+    from moco_tpu.config import get_preset
+    from moco_tpu.data.augment import aug_config_for
+
+    v1 = aug_config_for(get_preset("imagenet-moco-v1"))
+    assert v1.grayscale_first and v1.blur_prob == 0.0
+
+    v2 = aug_config_for(get_preset("imagenet-moco-v2"))
+    assert not v2.grayscale_first and v2.blur_prob == 0.5
+
+    pair = aug_config_for(get_preset("imagenet-moco-v3-vits"))
+    assert isinstance(pair, tuple) and len(pair) == 2
+    a, b = pair
+    assert a.blur_prob == 1.0 and b.solarize_prob == 0.2
+    assert a.min_scale == get_preset("imagenet-moco-v3-vits").crop_min or a.min_scale == 0.08

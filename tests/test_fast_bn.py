@@ -253,3 +253,27 @@ def test_custom_vjp_gate(monkeypatch):
         assert not fbn._use_custom_vjp()
     monkeypatch.setenv("MOCO_TPU_BN_VJP", "1")
     assert fbn._use_custom_vjp()      # forced on even off-TPU
+
+
+def test_env_flag_zero_means_off_everywhere(monkeypatch):
+    """Uniform '0'-means-off across ALL Pallas switches, including the
+    DISABLE_* spellings: MOCO_TPU_DISABLE_PALLAS=0 must NOT kill the
+    kernel families (review, r5)."""
+    import unittest.mock as mock
+
+    import moco_tpu.data.augment as aug
+    import moco_tpu.models.fast_bn as fbn
+    import moco_tpu.models.fused_block as fb
+
+    with mock.patch.object(jax, "default_backend", lambda: "tpu"):
+        monkeypatch.setenv("MOCO_TPU_DISABLE_PALLAS", "0")
+        monkeypatch.setenv("MOCO_TPU_PALLAS_BN", "1")
+        assert fbn._use_pallas()          # "0" disable = not disabled
+        assert fb._use_pallas()
+        cfg = aug.v2_aug_config(out_size=16)
+        monkeypatch.setenv("MOCO_TPU_DISABLE_PALLAS_BLUR", "0")
+        assert aug._use_pallas_blur(cfg)
+        monkeypatch.setenv("MOCO_TPU_DISABLE_PALLAS", "1")
+        assert not fbn._use_pallas()
+        assert not fb._use_pallas()
+        assert not aug._use_pallas_blur(cfg)

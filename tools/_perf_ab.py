@@ -30,6 +30,9 @@ p.add_argument("--pallas-bn", action="store_true",
 p.add_argument("--disable-pallas-blur", action="store_true",
                help="disable only the aug blur stencil kernel")
 p.add_argument("--batches", default="128,256")
+p.add_argument("--preset", default="imagenet-moco-v2",
+               help="any pretrain preset; v3 presets time the queue-free "
+                    "step with the asymmetric aug pair")
 p.add_argument("--stats-tile-kib", type=int, default=0,
                help="override pallas_stats per-operand tile target (KiB)")
 p.add_argument("--label", default="")
@@ -88,7 +91,7 @@ for B in (int(b) for b in args.batches.split(",")):
     # live in moco_tpu.utils.benchkit, shared with bench.py and
     # tools/_tpu_validate.py, so the A/B cannot drift from what the bench
     # publishes (review, r5)
-    config = get_preset("imagenet-moco-v2").replace(batch_size=B, dataset="synthetic")
+    config = get_preset(args.preset).replace(batch_size=B, dataset="synthetic")
     fused, state, imgs, ext = build_v2_fused_bench(config, mesh)
     best, warm_s, _loss, state = time_fused_step(
         fused, state, imgs, ext, warmup=10, steps=20, rounds=3)
