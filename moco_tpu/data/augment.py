@@ -461,6 +461,13 @@ def _use_pallas_blur(cfg: AugConfig) -> bool:
         # commutes with linear ops — solarize is nonlinear, so v3's
         # solarizing view keeps the in-pipeline (portable) blur
         return False
+    if cfg.pallas_blur == "on":
+        # explicit force-on wins over backend/env (the AugConfig contract:
+        # auto|on|off) — this is how the CPU interpret-mode equivalence
+        # tests exercise the kernel off-TPU; the r5 env_flag refactor
+        # briefly dropped this branch and the tests passed vacuously
+        # (review, r5)
+        return True
     from moco_tpu.utils.envflags import env_flag
 
     # MOCO_TPU_DISABLE_PALLAS_BLUR: blur-only switch so tools/_perf_ab.py

@@ -129,6 +129,11 @@ class SyntheticTextureDataset:
         g = np.random.RandomState(7777)
         tiles = g.rand(num_classes, 8, 8).astype(np.float32)
         tiles -= tiles.mean(axis=(1, 2), keepdims=True)  # zero-mean signal
+        # exposed so held-out-split construction is PINNABLE: train/val
+        # instances must share these regardless of `seed` (the eval
+        # val-split bug r5 fixed scored a probe against a different
+        # generator's labels — tests/test_evals.py)
+        self.class_tiles = tiles
         rng = np.random.RandomState(seed)
         labels = rng.randint(0, num_classes, size=num_samples)
         reps = image_size // 8

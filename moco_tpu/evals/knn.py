@@ -92,7 +92,7 @@ def run_knn(config: EvalConfig, mesh=None) -> float:
         config.dataset, config.data_dir, image_size=config.image_size,
         stage_size=config.stage_size, num_workers=config.num_workers,
     )
-    val_set = _val_split(config)
+    val_set = _val_split(config, train_set)
     bank, bank_labels = encode_dataset(model, params, stats, train_set, config, mesh=mesh)
     queries, qlabels = encode_dataset(model, params, stats, val_set, config, mesh=mesh)
     acc = knn_accuracy(

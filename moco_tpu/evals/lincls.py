@@ -200,7 +200,7 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
         config.dataset, config.data_dir, image_size=config.image_size,
         stage_size=config.stage_size, num_workers=config.num_workers,
     )
-    val_set = _val_split(config)
+    val_set = _val_split(config, train_set)
     model, backbone_params, backbone_stats = load_frozen_backbone(config)
     # pin the frozen backbone REPLICATED across the mesh once — otherwise the
     # uncommitted host arrays get re-placed on every jitted step
@@ -331,9 +331,19 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
     return fc, best_acc1
 
 
-def _val_split(config: EvalConfig):
+def _val_split(config: EvalConfig, train_set=None):
     """Validation dataset: `val/` dir for imagefolder, test split for
-    CIFAR-10, a held-out synthetic set otherwise."""
+    CIFAR-10, a held-out SAME-KIND synthetic set otherwise.
+
+    The synthetic branch must preserve the dataset KIND: the texture
+    dataset's class tiles come from a fixed internal seed exactly so a
+    different-`seed` instance is a held-out split of the SAME classes
+    (datasets.py::SyntheticTextureDataset). Before r5 this fell through
+    to `SyntheticDataset` for `synthetic_texture` probes, scoring the
+    head against labels from a different generator — the on-chip probe
+    of the gate-passing horizon encoder showed the signature (train Acc
+    99.7%, val Acc BELOW chance, runs/lincls_tpu_r5.log) that exposed
+    it."""
     if config.dataset == "imagefolder":
         import os
 
@@ -346,6 +356,18 @@ def _val_split(config: EvalConfig):
         from moco_tpu.data.datasets import CIFAR10
 
         return CIFAR10(config.data_dir, train=False)
+    if config.dataset == "synthetic_texture":
+        from moco_tpu.data.datasets import SyntheticTextureDataset
+
+        # label space must MATCH the train split, which train_lincls
+        # builds with the dataset's own default class count — deriving
+        # from config.num_classes (1000 on the imagenet presets) would
+        # recreate the exact train/val label mismatch this branch fixes
+        # (review, r5); same convention as train.py::_monitor_val_split
+        train_nc = getattr(train_set, "num_classes", None)
+        kw = {"num_classes": train_nc} if train_nc else {}
+        return SyntheticTextureDataset(
+            num_samples=512, image_size=config.image_size, seed=999, **kw)
     from moco_tpu.data.datasets import SyntheticDataset
 
     return SyntheticDataset(num_samples=512, image_size=config.image_size, seed=999)

@@ -496,4 +496,11 @@ def test_aug_config_for_matches_variant():
     assert isinstance(pair, tuple) and len(pair) == 2
     a, b = pair
     assert a.blur_prob == 1.0 and b.solarize_prob == 0.2
-    assert a.min_scale == get_preset("imagenet-moco-v3-vits").crop_min or a.min_scale == 0.08
+    # crop_min plumbing, both directions: the vits preset leaves crop_min
+    # at 0 ("variant default") which must resolve to the ViT 0.08 — NOT
+    # propagate the raw 0.0 (degenerate zero-area crops); an explicit
+    # override must win
+    assert a.min_scale == 0.08
+    a20, _ = aug_config_for(
+        get_preset("imagenet-moco-v3-vits").replace(crop_min=0.2))
+    assert a20.min_scale == 0.2

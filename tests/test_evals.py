@@ -138,3 +138,36 @@ def test_lincls_evaluate_only(mesh8, exported_ckpt, tmp_path):
     for a, b in zip(jax.tree.leaves(fc_trained), jax.tree.leaves(fc_eval),
                     strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_val_split_preserves_synthetic_texture_kind():
+    """The synthetic val split must be the SAME dataset kind as training:
+    a synthetic_texture probe validated on SyntheticDataset images scores
+    the head against labels from a different generator (below-chance val
+    with near-perfect train — the on-chip r5 signature,
+    runs/lincls_tpu_r5.log). Class tiles are fixed across seeds, so a
+    held-out texture instance shares the train classes."""
+    import numpy as np
+
+    from moco_tpu.config import get_preset
+    from moco_tpu.data.datasets import SyntheticTextureDataset
+    from moco_tpu.evals.lincls import _val_split
+
+    # the dangerous default: imagenet-lincls leaves num_classes at 1000,
+    # but the train split is built with the dataset's own default class
+    # count — the val label space must follow the TRAIN SET, not config
+    cfg = get_preset("imagenet-lincls").replace(
+        dataset="synthetic_texture", image_size=32)
+    train = SyntheticTextureDataset(num_samples=64, image_size=32, seed=0)
+    val = _val_split(cfg, train)
+    assert isinstance(val, SyntheticTextureDataset)
+    assert val.num_classes == train.num_classes == 16
+
+    # same class tiles across seeds (the fixed-tile-seed contract)
+    np.testing.assert_array_equal(
+        np.asarray(train.class_tiles), np.asarray(val.class_tiles))
+
+    # non-default class count follows the train set too
+    train24 = SyntheticTextureDataset(num_samples=48, image_size=32,
+                                      num_classes=24, seed=0)
+    assert _val_split(cfg, train24).num_classes == 24
