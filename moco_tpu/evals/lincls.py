@@ -340,10 +340,12 @@ def _val_split(config: EvalConfig, train_set=None):
     different-`seed` instance is a held-out split of the SAME classes
     (datasets.py::SyntheticTextureDataset). Before r5 this fell through
     to `SyntheticDataset` for `synthetic_texture` probes, scoring the
-    head against labels from a different generator — the on-chip probe
-    of the gate-passing horizon encoder showed the signature (train Acc
-    99.7%, val Acc BELOW chance, runs/lincls_tpu_r5.log) that exposed
-    it."""
+    head against labels from a different generator — the first on-chip
+    probe of the gate-passing horizon encoder showed the signature
+    (train Acc 99.7%, val Acc 0.39%, BELOW the 6.25% chance) that
+    exposed it; that failing log lives in git history (the committed
+    runs/lincls_tpu_r5.log is the post-fix 100% run — see
+    runs/README.md)."""
     if config.dataset == "imagefolder":
         import os
 

@@ -33,6 +33,12 @@ p.add_argument("--batches", default="128,256")
 p.add_argument("--preset", default="imagenet-moco-v2",
                help="any pretrain preset; v3 presets time the queue-free "
                     "step with the asymmetric aug pair")
+p.add_argument("--remat", choices=("true", "false"), default=None,
+               help="force per-block rematerialization on/off (the train "
+                    "driver's bool convention); default = the preset's own "
+                    "value — NOTE imagenet-moco-v3-vitb defaults remat=TRUE, "
+                    "so a no-remat ViT-B baseline needs --remat false "
+                    "(review, r5)")
 p.add_argument("--stats-tile-kib", type=int, default=0,
                help="override pallas_stats per-operand tile target (KiB)")
 p.add_argument("--label", default="")
@@ -91,11 +97,16 @@ for B in (int(b) for b in args.batches.split(",")):
     # live in moco_tpu.utils.benchkit, shared with bench.py and
     # tools/_tpu_validate.py, so the A/B cannot drift from what the bench
     # publishes (review, r5)
-    config = get_preset(args.preset).replace(batch_size=B, dataset="synthetic")
+    config = get_preset(args.preset).replace(
+        batch_size=B, dataset="synthetic",
+        **({} if args.remat is None else {"remat": args.remat == "true"}))
+    # the label must reflect the EFFECTIVE remat (the vitb preset defaults
+    # remat=True — a flagless run is NOT a no-remat baseline; review, r5)
+    eff = f"{label}+remat" if config.remat and "remat" not in label else label
     fused, state, imgs, ext = build_v2_fused_bench(config, mesh)
     best, warm_s, _loss, state = time_fused_step(
         fused, state, imgs, ext, warmup=10, steps=20, rounds=3)
-    print(json.dumps({"ab": label, "batch": B,
+    print(json.dumps({"ab": eff, "batch": B,
                       "ms_per_step": round(best * 1e3, 2),
                       "imgs_per_s": round(B / best, 1),
                       "compile_warmup_s": round(warm_s, 1)}), flush=True)
