@@ -39,7 +39,8 @@ def _fake_ckpt(tmp_path, run_args=None):
 # the tool's own fingerprint for --steps 4 --batch 16 --samples 16:
 # samples=16, steps_per_epoch=1, epochs=4, total=4 (cpu: the subprocess
 # runs under MOCO_TPU_FORCE_CPU=1)
-ARGS_4_16 = {"steps": 4, "batch": 16, "samples": 16, "lr": 0.03,
+ARGS_4_16 = {"steps": 4, "batch": 16, "samples": 16,
+             "arch": "resnet18", "image_size": 32, "lr": 0.03,
              "momentum_ema": 0.99, "backend": "cpu",
              "compute_dtype": "float32"}
 
@@ -106,3 +107,18 @@ def test_baseline_sidecar_roundtrip(tmp_path):
         json.dump({"knn_val_top1_untrained": 0.123}, f)
     _, m3 = train(cfg.replace(resume="auto", epochs=3), dataset=data)
     assert m3["knn_val_top1_untrained"] == pytest.approx(0.123)
+
+
+def test_resume_accepts_pre_arch_fingerprint(tmp_path):
+    """Fingerprints written before the --arch/--image-size flags lack the
+    two keys; those runs WERE resnet18@32, so the migration must default
+    them rather than refuse (review, r5). Proven by reaching the NEXT
+    refusal (corrupt sidecar) instead of 'flags changed'."""
+    old = {k: v for k, v in ARGS_4_16.items()
+           if k not in ("arch", "image_size")}
+    ck = _fake_ckpt(tmp_path, old)
+    (tmp_path / "ck" / "untrained_baseline.json").write_text('{"knn_val')
+    r = _run_tool(ck)
+    assert r.returncode == 4, r.stdout + r.stderr
+    assert "untrained_baseline.json missing/corrupt" in r.stdout
+    assert "flags changed" not in r.stdout

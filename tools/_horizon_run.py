@@ -57,6 +57,11 @@ p.add_argument("--steps", type=int, default=3200)
 p.add_argument("--knn-every", type=int, default=1 if on_tpu else 2)
 p.add_argument("--samples", type=int, default=0,
                help="dataset size (0 = batch*128 capped at 16384)")
+p.add_argument("--arch", default="resnet18",
+               help="backbone (default = the certified resnet18 config; "
+                    "--arch resnet50 runs the FLAGSHIP width under the "
+                    "same gates — r5 supplementary evidence)")
+p.add_argument("--image-size", type=int, default=32)
 p.add_argument("--ckpt-dir", default="",
                help="Orbax checkpoint dir ('' = off): makes the long CPU "
                     "run preemption-proof — a killed run resumes with "
@@ -80,6 +85,7 @@ if args.ckpt_dir:
     # cannot run, and discovering that AFTER the remaining epochs wastes
     # the whole run (exit 4 semantics, just hours earlier).
     run_args = {"steps": total_steps, "batch": batch, "samples": samples,
+                "arch": args.arch, "image_size": args.image_size,
                 "lr": lr, "momentum_ema": args.momentum,
                 # numerics regime: a CPU-started f32 run must not silently
                 # resume on TPU in bf16 (or vice versa) — that would gate a
@@ -98,6 +104,12 @@ if args.ckpt_dir:
             print(f"resume refused: {args_path} missing/corrupt — cannot "
                   "prove the resumed flags match the original run", flush=True)
             sys.exit(4)
+        # fingerprints written before the r5 --arch/--image-size flags
+        # lack the two keys; their runs WERE resnet18@32, so defaulting
+        # preserves resumability of in-flight checkpoints while keeping
+        # the strict refusal for real mismatches (review, r5)
+        prev.setdefault("arch", "resnet18")
+        prev.setdefault("image_size", 32)
         if prev != run_args:
             print(f"resume refused: flags changed {prev} -> {run_args}",
                   flush=True)
@@ -122,8 +134,9 @@ if args.ckpt_dir:
             json.dump(run_args, f)
         os.replace(tmp, args_path)
 cfg = get_preset("cifar10-moco-v1").replace(
-    arch="resnet18", cifar_stem=True, dataset="synthetic_texture",
-    image_size=32, batch_size=batch, num_negatives=4096, embed_dim=128,
+    arch=args.arch, cifar_stem=True, dataset="synthetic_texture",
+    image_size=args.image_size, batch_size=batch, num_negatives=4096,
+    embed_dim=128,
     lr=lr, momentum_ema=args.momentum, cos=True, epochs=epochs,
     steps_per_epoch=None,
     knn_monitor=True, knn_every_epochs=args.knn_every,
@@ -133,12 +146,13 @@ cfg = get_preset("cifar10-moco-v1").replace(
     tb_dir="", print_freq=steps_per_epoch, num_workers=1,
     compute_dtype="bfloat16" if on_tpu else "float32",
 )
-data = SyntheticTextureDataset(num_samples=samples, image_size=32,
-                               num_classes=16)
+data = SyntheticTextureDataset(num_samples=samples,
+                               image_size=args.image_size, num_classes=16)
 chance = 1.0 / data.num_classes
 print(json.dumps({"lr": lr, "batch": batch, "momentum_ema": args.momentum,
                   "backend": jax.default_backend(),
-                  "config": f"horizon r5 (resnet18 32px K=4096, B={batch}, "
+                  "config": f"horizon r5 ({args.arch} {args.image_size}px "
+                            f"K=4096, B={batch}, "
                             f"m={args.momentum}, {samples}-sample "
                             f"synthetic_texture/16-class, {total_steps} steps)",
                   "chance_knn": chance,

@@ -81,6 +81,16 @@ if args.disable_pallas_blur:
 if args.stats_tile_kib:
     parts.append(f"tile{args.stats_tile_kib}k")
 label = args.label or ("+".join(parts) if parts else "default")
+# the label must reflect the EFFECTIVE remat: the vitb preset defaults
+# remat=True, so a flagless run is NOT a no-remat baseline. Computed ONCE
+# from the preset (remat is batch-independent) and appended
+# unconditionally when effective — a substring test would let a label
+# like "noremat" suppress the marker, the exact mislabel this prevents
+# (review, r5)
+_effective_remat = (args.remat == "true" if args.remat is not None
+                    else get_preset(args.preset).remat)
+if _effective_remat:
+    label += "+remat"
 # echo the EFFECTIVE tile at two reference shapes (R50 layer1/layer4): a
 # budget that aliases the default program shows up here instead of being
 # reported as a distinct sweep point (review, r5)
@@ -98,15 +108,11 @@ for B in (int(b) for b in args.batches.split(",")):
     # tools/_tpu_validate.py, so the A/B cannot drift from what the bench
     # publishes (review, r5)
     config = get_preset(args.preset).replace(
-        batch_size=B, dataset="synthetic",
-        **({} if args.remat is None else {"remat": args.remat == "true"}))
-    # the label must reflect the EFFECTIVE remat (the vitb preset defaults
-    # remat=True — a flagless run is NOT a no-remat baseline; review, r5)
-    eff = f"{label}+remat" if config.remat and "remat" not in label else label
+        batch_size=B, dataset="synthetic", remat=_effective_remat)
     fused, state, imgs, ext = build_v2_fused_bench(config, mesh)
     best, warm_s, _loss, state = time_fused_step(
         fused, state, imgs, ext, warmup=10, steps=20, rounds=3)
-    print(json.dumps({"ab": eff, "batch": B,
+    print(json.dumps({"ab": label, "batch": B,
                       "ms_per_step": round(best * 1e3, 2),
                       "imgs_per_s": round(B / best, 1),
                       "compile_warmup_s": round(warm_s, 1)}), flush=True)
