@@ -197,9 +197,21 @@ def orchestrate(mode: str) -> None:
         orch.flush()
         os._exit(0)
 
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, _flush_and_exit)
+    prev_handlers = {
+        sig: signal.signal(sig, _flush_and_exit)
+        for sig in (signal.SIGTERM, signal.SIGINT)
+    }
+    try:
+        _orchestrate_body(mode, orch)
+    finally:
+        # restore the callers' dispositions: an in-process caller (tests,
+        # embedding drivers) must not inherit a handler that os._exit(0)s
+        # their process on the next Ctrl-C
+        for sig, prev in prev_handlers.items():
+            signal.signal(sig, prev)
 
+
+def _orchestrate_body(mode: str, orch: "_Orchestrator") -> None:
     if mode == "input":  # never needs an accelerator
         orch.best = orch.run("cpu", "input", 300.0, _CPU_ENV)
         orch.flush()
@@ -436,7 +448,10 @@ def bench_e2e():
                 if n >= max_steps:
                     break
         finally:
-            loader.close()
+            # quietly: bench's child-attempt contract is that measurement
+            # orchestration never raises, and the max_steps break makes a
+            # stale staged-read error possible even on success
+            loader.close_quietly()
         if metrics is None:
             raise RuntimeError(
                 f"epoch_loader yielded zero batches (epoch {epoch}, "

@@ -17,6 +17,7 @@ Reference behavior being reproduced:
 
 from __future__ import annotations
 
+import json
 import os
 
 import jax
@@ -47,26 +48,111 @@ def _rekey(state: TrainState) -> TrainState:
     return state.replace(rng=jax.random.wrap_key_data(state.rng))
 
 
-def save_checkpoint(mgr: ocp.CheckpointManager, state: TrainState, step: int) -> None:
+def _position_path(directory: str, step: int) -> str:
+    return os.path.join(directory, ".position", f"{step}.json")
+
+
+def write_position(directory: str, step: int,
+                   position: tuple[int, int] | None) -> None:
+    """Record the data-stream position `(epoch, next_batch_index)` the run
+    will be at when restored from `step`. `step // steps_per_epoch`
+    arithmetic recovers it ONLY while steps and batches are aligned — a NaN
+    rollback's data-window skip breaks that permanently, after which a
+    resume placed by arithmetic silently replays consumed batches. Written
+    atomically on process 0; absent/corrupt sidecars fall back to the
+    arithmetic."""
+    if position is None or jax.process_index() != 0:
+        return
+    path = _position_path(directory, step)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"epoch": int(position[0]), "batch": int(position[1])}, f)
+    os.replace(tmp, path)
+
+
+def read_position(directory: str, step: int) -> tuple[int, int] | None:
+    try:
+        with open(_position_path(directory, step)) as f:
+            d = json.load(f)
+        return int(d["epoch"]), int(d["batch"])
+    except (OSError, ValueError, KeyError, TypeError, json.JSONDecodeError):
+        return None
+
+
+def _prune_sidecars(mgr: ocp.CheckpointManager) -> None:
+    """Drop manifest/position sidecars for steps the manager has
+    garbage-collected (max_to_keep) — nothing reads them again, and over a
+    multi-day run they accumulate without bound."""
+    if jax.process_index() != 0:
+        return
+    keep = {str(s) for s in mgr.all_steps()}
+    for sub in (".integrity", ".position"):
+        d = os.path.join(str(mgr.directory), sub)
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        for name in names:
+            stem, ext = os.path.splitext(name)
+            if ext == ".json" and stem.isdigit() and stem not in keep:
+                try:
+                    os.remove(os.path.join(d, name))
+                except OSError:
+                    pass  # lost a cleanup race; the next prune retries
+
+
+def save_checkpoint(
+    mgr: ocp.CheckpointManager, state: TrainState, step: int, wait: bool = True,
+    position: tuple[int, int] | None = None,
+) -> None:
+    """Save `state` at `step`. With `wait=True` (default), block until the
+    save finalizes and record an integrity manifest sidecar (process 0) so a
+    later `--resume auto` can walk back past a truncated/partial step
+    instead of crashing on it — the right mode for emergency saves (the
+    process exits next). With `wait=False` the save stays async so
+    serialization overlaps the next epoch's compute, and the manifest is
+    DEFERRED: `finalize_checkpoints` (called here on the next save, and by
+    the driver at run end / unwind) writes it once Orbax commits. A crash in
+    between leaves the step manifest-less, which restore treats as
+    unverified-but-restorable — nothing is bricked, that one step just
+    loses its cheap integrity gate. `position` (the `(epoch, next_batch)`
+    the restored run should resume the data stream at) is recorded as a
+    sidecar — see `write_position`."""
+    finalize_checkpoints(mgr)
+    write_position(str(mgr.directory), step, position)
     mgr.save(step, args=ocp.args.StandardSave(_unkey(state)))
+    if wait:
+        mgr.wait_until_finished()
+        if jax.process_index() == 0:
+            from moco_tpu.resilience.integrity import write_manifest
+
+            write_manifest(str(mgr.directory), step)
+        _prune_sidecars(mgr)
+    else:
+        mgr._moco_pending_manifest = step
 
 
-def restore_checkpoint(
+def finalize_checkpoints(mgr: ocp.CheckpointManager) -> None:
+    """Block until any in-flight async save commits, then write its deferred
+    integrity manifest. Idempotent; safe on managers with nothing pending."""
+    mgr.wait_until_finished()
+    step = getattr(mgr, "_moco_pending_manifest", None)
+    if step is not None:
+        mgr._moco_pending_manifest = None
+        if jax.process_index() == 0:
+            from moco_tpu.resilience.integrity import write_manifest
+
+            write_manifest(str(mgr.directory), step)
+        _prune_sidecars(mgr)
+
+
+def _restore_step(
     mgr: ocp.CheckpointManager,
     abstract_state: TrainState,
-    step: int | None = None,
+    step: int,
     sharding=None,
 ) -> TrainState:
-    """Restore `step` (or the latest). `abstract_state` provides the pytree
-    structure — pass a freshly-created state. With `sharding` (e.g. the
-    mesh-replicated NamedSharding), Orbax restores DIRECTLY into that
-    placement via ShapeDtypeStructs — each host reads its own shards, which
-    is the only correct route on multi-process meshes (a restore-then-
-    `device_put` would need cross-host transfers)."""
-    if step is None:
-        step = mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError("no checkpoint found to resume from")
     target = _unkey(abstract_state)
     if sharding is not None:
         import jax.numpy as jnp
@@ -78,6 +164,123 @@ def restore_checkpoint(
         target = jax.tree.map(to_abstract, target)
     restored = mgr.restore(step, args=ocp.args.StandardRestore(target))
     return _rekey(restored)
+
+
+def restore_checkpoint(
+    mgr: ocp.CheckpointManager,
+    abstract_state: TrainState,
+    step: int | None = None,
+    sharding=None,
+) -> TrainState:
+    """Restore `step`, or — with `step=None` — the newest step that VERIFIES
+    and restores, walking back past corrupt/partial newer ones with a loud
+    warning (a preempted writer's half-finished latest step must not brick
+    `--resume auto`). An EXPLICIT step still fails hard: the caller asked
+    for that step, silently handing back another would be worse than the
+    crash. `abstract_state` provides the pytree structure — pass a
+    freshly-created state. With `sharding` (e.g. the mesh-replicated
+    NamedSharding), Orbax restores DIRECTLY into that placement via
+    ShapeDtypeStructs — each host reads its own shards, which is the only
+    correct route on multi-process meshes (a restore-then-`device_put`
+    would need cross-host transfers)."""
+    if step is not None:
+        return _restore_step(mgr, abstract_state, step, sharding)
+    from moco_tpu.resilience.integrity import verify_step
+    from moco_tpu.utils.logging import log_event
+
+    steps = sorted(mgr.all_steps(), reverse=True)
+    if not steps:
+        raise FileNotFoundError("no checkpoint found to resume from")
+    directory = str(mgr.directory)
+    if jax.process_count() > 1:
+        # Orbax restore of multi-process arrays is COLLECTIVE: hosts making
+        # independent verify/fallback decisions desync the pod (host A falls
+        # back to an older step while the others' restore of the newer one
+        # is in flight — a mismatched collective that hangs or silently
+        # yields divergent states). So every decision here is agreed
+        # pod-wide: process 0 verifies and broadcasts the candidate order,
+        # and after each collective restore ATTEMPT the hosts allgather
+        # success — a failure anywhere (e.g. a manifest-less partial step
+        # from a mid-save kill, which verifies vacuously) walks ALL hosts
+        # back together instead of bricking --resume auto.
+        from jax.experimental import multihost_utils
+
+        verdicts = np.zeros(len(steps), np.int64)
+        if jax.process_index() == 0:
+            for k, s in enumerate(steps):
+                reason = verify_step(directory, s)
+                verdicts[k] = int(reason is None)
+                if reason is not None:
+                    log_event(
+                        "ckpt-restore",
+                        f"step {s} fails integrity check ({reason}); "
+                        "falling back to the next-older step",
+                    )
+        verdicts = np.asarray(multihost_utils.broadcast_one_to_all(verdicts))
+        failed: list[int] = []
+        for k, s in enumerate(steps):
+            if not verdicts[k]:
+                failed.append(s)
+                continue
+            try:
+                restored = _restore_step(mgr, abstract_state, s, sharding)
+                ok = True
+            except Exception as e:  # orbax raises backend-specific types
+                log_event(
+                    "ckpt-restore",
+                    f"restore of step {s} FAILED on this host "
+                    f"({type(e).__name__}: {e}); awaiting pod agreement",
+                )
+                restored, ok = None, False
+            all_ok = bool(
+                np.min(multihost_utils.process_allgather(np.int64(ok)))
+            )
+            if all_ok:
+                if failed:
+                    log_event(
+                        "ckpt-restore",
+                        f"restored OLDER step {s} after skipping {failed} — "
+                        f"up to {steps[0] - s} steps of progress lost",
+                    )
+                return restored
+            failed.append(s)
+        raise FileNotFoundError(
+            f"no restorable checkpoint in {directory}; all candidates "
+            f"failed: {failed}"
+        )
+    skipped: list[tuple[int, str]] = []
+    for s in steps:
+        reason = verify_step(directory, s)
+        if reason is not None:
+            log_event(
+                "ckpt-restore",
+                f"step {s} fails integrity check ({reason}); "
+                "falling back to the next-older step",
+            )
+            skipped.append((s, reason))
+            continue
+        try:
+            restored = _restore_step(mgr, abstract_state, s, sharding)
+        except Exception as e:  # orbax raises backend-specific types
+            log_event(
+                "ckpt-restore",
+                f"restore of step {s} FAILED ({type(e).__name__}: {e}); "
+                "falling back to the next-older step",
+            )
+            skipped.append((s, repr(e)))
+            continue
+        if skipped:
+            log_event(
+                "ckpt-restore",
+                f"restored OLDER step {s} after skipping "
+                f"{[x[0] for x in skipped]} — up to "
+                f"{steps[0] - s} steps of progress lost to corrupt saves",
+            )
+        return restored
+    raise FileNotFoundError(
+        f"no restorable checkpoint in {directory}; all candidates failed: "
+        f"{skipped}"
+    )
 
 
 def maybe_resume(

@@ -99,6 +99,37 @@ class PretrainConfig:
     resume: str = ""                  # path | "auto"
     export_path: str = ""             # write encoder_q (.safetensors/.npz) at end
     steps_per_epoch: int | None = None  # derived from dataset unless set
+    # fault tolerance (resilience/; preemptible-VM pretraining survives
+    # SIGTERM, corrupt checkpoints, NaN losses, and flaky reads unattended)
+    loss_sentinel: bool = True        # every-step non-finite-loss check
+                                      # (one-step lag — no pipeline bubble)
+    max_rollbacks: int = 3            # consecutive NaN rollbacks before the
+                                      # run aborts (0 = never roll back:
+                                      # a non-finite loss raises immediately)
+    watchdog_secs: float = 0.0        # flag when no step completes within
+                                      # this window (0 = watchdog off)
+    loader_retries: int = 3           # transient data-read retries per batch
+                                      # (Prefetcher, exponential backoff)
+    loader_backoff_secs: float = 0.5  # base backoff delay between retries
+    decode_abort_rate: float = 0.5    # abort (DataQualityError) when the
+                                      # cumulative decode-failure rate
+                                      # exceeds this after the first host
+                                      # batch (0 = never abort; failures are
+                                      # still metered either way)
+    resilience_sync_steps: int = 16   # multi-host only: cadence (in steps)
+                                      # at which per-host fault signals
+                                      # (SIGTERM flag, decode counters) are
+                                      # allgathered so every host acts on
+                                      # them identically — one host breaking
+                                      # alone hangs the rest in the next
+                                      # collective (0 disables the sync,
+                                      # and with it preemption handling and
+                                      # the decode abort on multi-host runs)
+    chaos: str = ""                   # fault-injection spec for drills/tests,
+                                      # e.g. "sigterm_at_step=100" or
+                                      # "nan_at_step=3,loader_error_at_batch=7"
+                                      # (resilience/chaos.py; also via the
+                                      # MOCO_TPU_CHAOS env var)
     knn_monitor: bool = False         # periodic kNN top-1 during pretrain
     knn_every_epochs: int = 1         # monitor cadence (the eval costs ~160 s
                                       # on the 1-core sandbox — long CPU runs

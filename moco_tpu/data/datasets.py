@@ -235,6 +235,11 @@ class ImageFolder:
         self._native = None
         self._backend = backend
         self._native_workers = num_workers
+        # cumulative decode telemetry (read by the train driver every step):
+        # failures substitute zero canvases, which poison training silently —
+        # the driver meters the rate and aborts past config.decode_abort_rate
+        self.decode_failures = 0
+        self.decode_total = 0
         classes = sorted(
             d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
         )
@@ -306,17 +311,43 @@ class ImageFolder:
         canvas[nh:, :] = canvas[nh - 1 : nh, :]
         return canvas, np.asarray([nh, nw, rot], np.int32)
 
+    def _load_one_tolerant(self, idx: int):
+        """`_load_one` that degrades a per-image decode failure into a zero
+        canvas + counted failure instead of killing the epoch — one corrupt
+        file in a million-image tree must not end a multi-day run; the
+        driver-level failure-rate threshold (`decode_abort_rate`) catches
+        the systemic case."""
+        try:
+            canvas, extent = self._load_one(idx)
+            return canvas, extent, 0
+        except (OSError, ValueError) as e:
+            from moco_tpu.utils.logging import log_event
+
+            log_event(
+                "data",
+                f"decode failed for {self.entries[idx].path!r} "
+                f"({type(e).__name__}: {e}); substituting a zero canvas",
+            )
+            canvas = np.zeros((self.stage_h, self.stage_w, 3), np.uint8)
+            extent = np.asarray([self.stage_h, self.stage_w, 0], np.int32)
+            return canvas, extent, 1
+
     def get_batch(self, indices: np.ndarray):
         idx = [int(i) for i in indices]
         paths = [self.entries[i].path for i in idx]
+        self.decode_total += len(idx)
         if self._native is not None and all(
             p.lower().endswith((".jpg", ".jpeg")) for p in paths
         ):
             imgs, extents, failures = self._native.load_batch(paths)
             if failures == 0:
                 return imgs, self.labels[indices], extents
-            # corrupt files: fall through to PIL for a precise error surface
-        staged = list(self._pool.map(self._load_one, idx))
+            # native failures: retry the whole batch via PIL — it decodes
+            # some streams libjpeg rejects, and pinpoints the bad file(s)
+        staged = list(self._pool.map(self._load_one_tolerant, idx))
+        failed = sum(s[2] for s in staged)
+        if failed:
+            self.decode_failures += failed
         imgs = np.stack([s[0] for s in staged])
         extents = np.stack([s[1] for s in staged])
         return imgs, self.labels[indices], extents

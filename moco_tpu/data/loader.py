@@ -23,6 +23,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from moco_tpu.parallel.mesh import DATA_AXIS
+from moco_tpu.resilience.chaos import active_chaos
+from moco_tpu.utils.logging import log_event
 
 
 def epoch_permutation(n: int, epoch: int, seed: int, global_batch: int) -> np.ndarray:
@@ -49,19 +51,31 @@ def host_shard(indices: np.ndarray, global_batch: int) -> np.ndarray:
     return batches[:, pid * per_host : (pid + 1) * per_host].reshape(-1)
 
 
+class _CloseRequested(Exception):
+    """Internal: the consumer called close() while the staging worker was in
+    retry backoff — the worker exits quietly instead of surfacing the
+    transient error as if the run had failed."""
+
+
 class Prefetcher:
     """Iterate `(images_u8, labels)` device-sharded batches with background
     host staging."""
 
-    def __init__(self, dataset, indices: np.ndarray, batch_per_host: int, mesh: Mesh, depth: int = 2):
+    def __init__(self, dataset, indices: np.ndarray, batch_per_host: int, mesh: Mesh,
+                 depth: int = 2, retries: int = 3, backoff_secs: float = 0.5,
+                 join_timeout: float = 5.0):
         self.dataset = dataset
         self.indices = indices
         self.batch = batch_per_host
         self.sharding = NamedSharding(mesh, P(DATA_AXIS))
         self.num_batches = len(indices) // batch_per_host
+        self.retries = retries
+        self.backoff_secs = backoff_secs
+        self._join_timeout = join_timeout
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._err: BaseException | None = None
+        self._err_delivered = False
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
@@ -70,14 +84,47 @@ class Prefetcher:
         # consumer — a silently-dead thread would hang training on q.get()
         try:
             for b in range(self.num_batches):
-                item = self.dataset.get_batch(
-                    self.indices[b * self.batch : (b + 1) * self.batch]
-                )
+                item = self._read_batch(b)
                 if not self._put(item):
                     return
+        except _CloseRequested:
+            # consumer closed while we were in retry backoff: the read was
+            # still within its retry budget, so recording it as a worker
+            # error would make close() crash a run that finished all its
+            # steps
+            return
         except Exception as e:
             self._err = e
         self._put(None)
+
+    def _read_batch(self, b: int):
+        """One staged batch, with retry-with-backoff on transient read
+        errors (flaky NFS/GCS, chaos-injected faults). OSError covers both
+        real storage faults and `TransientDataError`; anything else is a
+        programming/data-layout error and fails fast as before."""
+        attempt = 0
+        while True:
+            try:
+                plan = active_chaos()
+                if plan is not None:
+                    plan.maybe_loader_error(b)
+                return self.dataset.get_batch(
+                    self.indices[b * self.batch : (b + 1) * self.batch]
+                )
+            except OSError as e:
+                attempt += 1
+                if attempt > self.retries:
+                    raise
+                delay = self.backoff_secs * (2 ** (attempt - 1))
+                log_event(
+                    "loader",
+                    f"batch {b} read failed ({type(e).__name__}: {e}); "
+                    f"retry {attempt}/{self.retries} in {delay:.2f}s",
+                )
+                if self._stop.wait(delay):
+                    # consumer closed mid-backoff: stop retrying, and exit
+                    # the worker WITHOUT recording the transient error
+                    raise _CloseRequested() from e
 
     def _put(self, item) -> bool:
         while not self._stop.is_set():
@@ -91,14 +138,42 @@ class Prefetcher:
     def close(self):
         """Unblock and join the staging thread (consumers that break out of
         the iterator early MUST call this or the thread + `depth` staged
-        batches leak for the life of the process)."""
+        batches leak for the life of the process). A worker error the
+        iterator never reached (early break) is re-raised here — data
+        corruption must not vanish just because the consumer left first."""
         self._stop.set()
         while not self._q.empty():
             try:
                 self._q.get_nowait()
             except queue.Empty:
                 break
-        self._thread.join(timeout=5.0)
+        self._thread.join(timeout=self._join_timeout)
+        if self._thread.is_alive():
+            log_event(
+                "loader",
+                f"staging thread still alive {self._join_timeout:.1f}s after "
+                "close() — a dataset read is wedged; leaking the (daemon) "
+                "thread rather than blocking shutdown",
+            )
+        if self._err is not None and not self._err_delivered:
+            self._err_delivered = True
+            raise self._err
+
+    def close_quietly(self) -> None:
+        """close(), demoting a pending worker error to a loud log. For driver
+        loops: the error necessarily belongs to a staged-ahead batch the
+        consumer never used (errors on consumed batches surface through the
+        iterator), so on an early stop (total_steps, preemption) it must not
+        void a run whose every consumed step succeeded — and on an unwind it
+        must not REPLACE the exception already in flight."""
+        try:
+            self.close()
+        except Exception as e:
+            log_event(
+                "loader",
+                f"staged-read error for a batch the consumer never used "
+                f"(stopped early) — logged, not raised: {e!r}",
+            )
 
     def _to_device(self, arr, sharding):
         if jax.process_count() > 1:
@@ -112,6 +187,7 @@ class Prefetcher:
             item = self._q.get()
             if item is None:
                 if self._err is not None:
+                    self._err_delivered = True
                     raise self._err
                 return
             # (images, labels, extents) — every element is batch-leading,
@@ -151,16 +227,18 @@ def stage_eval_batch(item, batch: int, sharding=None, pad_label=None):
 
 def epoch_loader(
     dataset, epoch: int, seed: int, global_batch: int, mesh: Mesh,
-    skip_batches: int = 0,
+    skip_batches: int = 0, retries: int = 3, backoff_secs: float = 0.5,
 ) -> Prefetcher:
     """One epoch of sharded batches (sampler.set_epoch + DataLoader in one).
 
     `skip_batches` drops the first N global batches at the index level (no
     decode, no H2D) — used by mid-epoch resume to fast-forward to the first
-    unconsumed batch of the interrupted epoch."""
+    unconsumed batch of the interrupted epoch. `retries`/`backoff_secs`
+    configure the Prefetcher's transient-read retry policy."""
     perm = epoch_permutation(len(dataset), epoch, seed, global_batch)
     local = host_shard(perm, global_batch)
     per_host = global_batch // jax.process_count()
     if skip_batches:
         local = local[skip_batches * per_host:]
-    return Prefetcher(dataset, local, per_host, mesh)
+    return Prefetcher(dataset, local, per_host, mesh,
+                      retries=retries, backoff_secs=backoff_secs)

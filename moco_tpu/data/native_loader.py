@@ -23,6 +23,8 @@ import threading
 
 import numpy as np
 
+from moco_tpu.utils.logging import log_event
+
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libstaging_loader.so"))
 _build_lock = threading.Lock()
@@ -69,6 +71,11 @@ class NativeStagingLoader:
             num_threads = max(os.cpu_count() or 1, 1)
         self.stage_h = stage_h
         self.stage_w = stage_w
+        # cumulative decode telemetry: a zero-canvas batch poisoning training
+        # must be VISIBLE (metered by the driver, ISSUE 1 satellite), not a
+        # discarded return value
+        self.total_images = 0
+        self.total_failures = 0
         self._handle = self._lib.sl_create(num_threads, stage_h, stage_w)
         if not self._handle:
             raise RuntimeError("sl_create failed")
@@ -88,7 +95,16 @@ class NativeStagingLoader:
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             extents.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         )
-        return out, extents, int(failures)
+        failures = int(failures)
+        self.total_images += n
+        if failures:
+            self.total_failures += failures
+            log_event(
+                "data",
+                f"native decode: {failures}/{n} failure(s) in batch "
+                f"(cumulative {self.total_failures}/{self.total_images})",
+            )
+        return out, extents, failures
 
     def __del__(self):
         handle = getattr(self, "_handle", None)

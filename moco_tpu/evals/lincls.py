@@ -301,7 +301,10 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
                 if step >= total:
                     break
         finally:
-            loader.close()
+            # quietly: a pending staged-read error would mask an in-flight
+            # exception here, and the early `step >= total` break makes a
+            # stale error for an unconsumed batch possible on success too
+            loader.close_quietly()
         acc1, acc5 = validate(eval_step, fc, backbone_params, backbone_stats,
                               val_set, config, mesh)
         best_acc1 = max(best_acc1, acc1)

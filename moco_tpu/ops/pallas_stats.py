@@ -27,6 +27,8 @@ import os
 
 import jax
 import jax.numpy as jnp
+
+from moco_tpu.utils.compat import shape_dtype_struct
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -125,8 +127,8 @@ def channel_sums(x: jax.Array, interpret: bool = False):
             pl.BlockSpec((1, c), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, c), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((1, c), jnp.float32, vma=vma),
+            shape_dtype_struct((1, c), jnp.float32, vma=vma),
+            shape_dtype_struct((1, c), jnp.float32, vma=vma),
         ],
         interpret=interpret,
     )(xr)
@@ -164,8 +166,8 @@ def channel_grad_sums(
             pl.BlockSpec((1, c), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, c), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((1, c), jnp.float32, vma=vma),
+            shape_dtype_struct((1, c), jnp.float32, vma=vma),
+            shape_dtype_struct((1, c), jnp.float32, vma=vma),
         ],
         interpret=interpret,
     )(dyr, xr, mean.reshape(1, c).astype(jnp.float32),

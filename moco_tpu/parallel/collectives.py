@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from moco_tpu.utils.compat import axis_size
+
 
 def all_gather_batch(x: jax.Array, axis_name: str) -> jax.Array:
     """Gather local batch shards into the global batch along dim 0.
@@ -60,7 +62,7 @@ def batch_shuffle(
     replicated train-state key) — divergent keys would silently desynchronise
     the shuffle; tests/test_collectives.py pins this.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     x_all = all_gather_batch(x, axis_name)  # [B_global, ...]
     global_b = x_all.shape[0]
@@ -73,7 +75,7 @@ def batch_unshuffle(x: jax.Array, perm: jax.Array, axis_name: str) -> jax.Array:
     """Undo `batch_shuffle` (rebuild of `_batch_unshuffle_ddp`,
     `moco/builder.py:≈L100-115`): gather the shuffled global batch, index it
     with this device's slice of the inverse permutation."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     x_all = all_gather_batch(x, axis_name)
     global_b = x_all.shape[0]
@@ -96,7 +98,7 @@ def ring_shuffle(x: jax.Array, axis_name: str, inverse: bool = False) -> jax.Arr
     `batch_shuffle` stays the semantically faithful default
     (`shuffle_mode="permute"`).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if x.shape[0] % 2:
         raise ValueError("ring_shuffle requires an even local batch")
     h = x.shape[0] // 2

@@ -1,0 +1,71 @@
+"""Fault tolerance for long-horizon pretraining (ISSUE 1 tentpole).
+
+The north-star run is a multi-day MoCo pretrain on PREEMPTIBLE TPU VMs;
+this package makes that survivable without a babysitter:
+
+- `preemption.PreemptionHandler` — SIGTERM/SIGINT caught, the in-flight
+  step finishes, an emergency step-tagged checkpoint lands, the process
+  exits cleanly (the driver's mid-epoch `resume_skip` path makes the
+  resumed run bit-identical to the uninterrupted one).
+- `integrity.write_manifest`/`verify_step` — per-save digest sidecars so
+  `--resume auto` walks BACK to the newest verifiable step instead of
+  crashing on a truncated/partial latest checkpoint.
+- `sentinel.NaNSentinel` — every-step non-finite-loss detection (one-step
+  lag, so the device pipeline never bubbles); the driver answers with a
+  bounded rollback: restore the last good checkpoint, advance the data
+  permutation past the poisoned window, abort only after
+  `max_rollbacks` consecutive rollbacks.
+- `watchdog.StepWatchdog` — flags step-time hangs from a background
+  thread (a stuck collective on a pod otherwise looks like silence).
+- `chaos.ChaosPlan` — the deterministic fault-injection harness that
+  makes all of the above TESTABLE on CPU: SIGTERM-at-step-k,
+  NaN-at-step-k, loader faults, checkpoint truncation.
+
+Errors are typed (`errors.py`) so callers can route retryable faults
+(`TransientDataError`) differently from run-enders
+(`RollbackExhaustedError`, `DataQualityError`).
+"""
+
+from moco_tpu.resilience.chaos import (
+    ChaosPlan,
+    active_chaos,
+    chaos_context,
+    clear_chaos,
+    install_chaos,
+    parse_chaos_spec,
+    truncate_checkpoint,
+)
+from moco_tpu.resilience.errors import (
+    DataQualityError,
+    NonFiniteLossError,
+    RollbackExhaustedError,
+    TransientDataError,
+)
+from moco_tpu.resilience.integrity import (
+    manifest_path,
+    verify_step,
+    write_manifest,
+)
+from moco_tpu.resilience.preemption import PreemptionHandler
+from moco_tpu.resilience.sentinel import NaNSentinel
+from moco_tpu.resilience.watchdog import StepWatchdog
+
+__all__ = [
+    "ChaosPlan",
+    "DataQualityError",
+    "NaNSentinel",
+    "NonFiniteLossError",
+    "PreemptionHandler",
+    "RollbackExhaustedError",
+    "StepWatchdog",
+    "TransientDataError",
+    "active_chaos",
+    "chaos_context",
+    "clear_chaos",
+    "install_chaos",
+    "manifest_path",
+    "parse_chaos_spec",
+    "truncate_checkpoint",
+    "verify_step",
+    "write_manifest",
+]

@@ -1,0 +1,177 @@
+"""Deterministic fault-injection harness (the ISSUE 1 headline deliverable).
+
+Every recovery path in this package is exercised by INJECTED faults in CPU
+tier-1 tests instead of trusted on faith: a `ChaosPlan` names the fault and
+the exact step/batch it fires at, the driver and loader poll the installed
+plan at their hook points, and each fault fires AT MOST ONCE — so a run
+that rolls back and re-traverses the same step numbers is not re-poisoned,
+and the whole scenario is reproducible bit-for-bit.
+
+Install programmatically (tests):
+
+    with chaos_context(ChaosPlan(sigterm_at_step=11)):
+        train(config, mesh)
+
+or from the CLI / env for operational drills:
+
+    python -m moco_tpu.train --preset ... --chaos "nan_at_step=300"
+    MOCO_TPU_CHAOS="sigterm_at_step=5000" python -m moco_tpu.train ...
+
+`truncate_checkpoint` is the storage-fault injector: it corrupts the
+largest payload file of a saved step in place, the way a preempted or
+out-of-quota writer leaves partial checkpoints.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+from dataclasses import dataclass, field
+
+from moco_tpu.resilience.errors import TransientDataError
+from moco_tpu.utils.logging import log_event
+
+
+@dataclass
+class ChaosPlan:
+    """One deterministic fault scenario. Steps count COMPLETED train steps
+    (the driver's `global_step` after the increment); batches are the
+    Prefetcher's 0-based batch index within its epoch."""
+
+    sigterm_at_step: int | None = None      # deliver SIGTERM after step k
+    nan_at_step: int | None = None          # poison the reported loss at step k
+    nan_count: int = 1                      # re-poison step k on re-traversal
+                                            # up to this many times (>1 models
+                                            # a STRUCTURAL divergence that the
+                                            # data-window advance cannot fix —
+                                            # the rollback-exhaustion path)
+    loader_error_at_batch: int | None = None  # Prefetcher read fault at batch b
+    loader_error_count: int = 1             # consecutive faults before recovery
+    _fired: set = field(default_factory=set, repr=False)
+    _nans_raised: int = field(default=0, repr=False)
+    _loader_errors_raised: int = field(default=0, repr=False)
+
+    def _fire_once(self, key: str) -> bool:
+        if key in self._fired:
+            return False
+        self._fired.add(key)
+        return True
+
+    def maybe_sigterm(self, step: int) -> None:
+        """Deliver a real SIGTERM through the OS so the actual signal-handler
+        path is exercised, not a simulation of it."""
+        if self.sigterm_at_step == step and self._fire_once("sigterm"):
+            log_event("chaos", f"injecting SIGTERM at step {step}")
+            signal.raise_signal(signal.SIGTERM)
+
+    def maybe_nan(self, step: int) -> bool:
+        """True at the configured step (the first `nan_count` traversals of
+        it): the caller replaces the step's reported loss with NaN — the
+        sentinel's detection and the driver's rollback then run for real."""
+        if self.nan_at_step == step and self._nans_raised < self.nan_count:
+            self._nans_raised += 1
+            log_event(
+                "chaos",
+                f"injecting non-finite loss at step {step} "
+                f"({self._nans_raised}/{self.nan_count})",
+            )
+            return True
+        return False
+
+    def maybe_loader_error(self, batch_index: int) -> None:
+        """Raise `TransientDataError` for the first `loader_error_count`
+        attempts at the configured batch — the retry-with-backoff path must
+        survive exactly that many consecutive failures."""
+        if (
+            self.loader_error_at_batch == batch_index
+            and self._loader_errors_raised < self.loader_error_count
+        ):
+            self._loader_errors_raised += 1
+            raise TransientDataError(
+                f"chaos: injected read failure {self._loader_errors_raised}/"
+                f"{self.loader_error_count} at batch {batch_index}"
+            )
+
+
+_INT_FIELDS = (
+    "sigterm_at_step",
+    "nan_at_step",
+    "nan_count",
+    "loader_error_at_batch",
+    "loader_error_count",
+)
+
+
+def parse_chaos_spec(spec: str) -> ChaosPlan | None:
+    """`"sigterm_at_step=11,nan_at_step=3"` → ChaosPlan. Empty spec → None.
+    Unknown keys are rejected loudly — a typo'd fault that silently never
+    fires would make a chaos drill vacuous."""
+    spec = spec.strip()
+    if not spec:
+        return None
+    kw: dict[str, int] = {}
+    for part in spec.split(","):
+        key, _, value = part.partition("=")
+        key = key.strip()
+        if key not in _INT_FIELDS:
+            raise ValueError(
+                f"unknown chaos fault {key!r}; known: {', '.join(_INT_FIELDS)}"
+            )
+        kw[key] = int(value)
+    return ChaosPlan(**kw)
+
+
+# One plan per process: the hooks live in a worker thread (Prefetcher) and
+# the main loop, so the registry is module-global rather than threaded
+# through every call signature.
+_ACTIVE: ChaosPlan | None = None
+
+
+def install_chaos(plan: ChaosPlan | None) -> None:
+    global _ACTIVE
+    _ACTIVE = plan
+
+
+def clear_chaos() -> None:
+    install_chaos(None)
+
+
+def active_chaos() -> ChaosPlan | None:
+    if _ACTIVE is None:
+        env = os.environ.get("MOCO_TPU_CHAOS", "")
+        if env:
+            # env-installed plans persist for the process (fire-once state
+            # must survive multiple polls)
+            install_chaos(parse_chaos_spec(env))
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def chaos_context(plan: ChaosPlan):
+    """Scoped install for tests — guarantees no plan leaks into the next
+    test even when the body raises (most chaos scenarios end in a raise)."""
+    install_chaos(plan)
+    try:
+        yield plan
+    finally:
+        clear_chaos()
+
+
+def truncate_checkpoint(ckpt_dir: str, step: int) -> str:
+    """Corrupt the saved `step` the way a preempted writer does: truncate its
+    largest payload file to half. Returns the mangled file's path."""
+    root = os.path.join(os.path.abspath(ckpt_dir), str(step))
+    largest, size = None, -1
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fname in filenames:
+            p = os.path.join(dirpath, fname)
+            s = os.path.getsize(p)
+            if s > size:
+                largest, size = p, s
+    if largest is None:
+        raise FileNotFoundError(f"no files under checkpoint step dir {root}")
+    with open(largest, "r+b") as f:
+        f.truncate(size // 2)
+    log_event("chaos", f"truncated {largest} from {size} to {size // 2} bytes")
+    return largest

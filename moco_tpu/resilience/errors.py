@@ -1,0 +1,42 @@
+"""Typed fault-tolerance errors (resilience package contract).
+
+The type encodes the RECOVERY POLICY, which is why these are not plain
+RuntimeErrors: `TransientDataError` is retried with backoff by the
+Prefetcher, `NonFiniteLossError` triggers a checkpoint rollback in the
+driver, and the two *Exhausted/Quality errors are deliberate run-enders
+that no layer should catch."""
+
+from __future__ import annotations
+
+
+class TransientDataError(OSError):
+    """A dataset/storage read that is worth retrying (flaky NFS/GCS read,
+    chaos-injected loader fault). Subclasses OSError so generic IO retry
+    policies treat the two identically."""
+
+
+class NonFiniteLossError(FloatingPointError):
+    """The per-step sentinel saw a non-finite loss. `step` is the number of
+    COMPLETED steps at the poisoned step. `pos` is the `(epoch, batch_index)`
+    the poisoned batch was consumed at — the rollback skips THROUGH that
+    position, which stays correct even when earlier skips have drifted the
+    step↔batch mapping (step arithmetic alone cannot recover it then)."""
+
+    def __init__(self, step: int, value: float,
+                 pos: tuple[int, int] | None = None):
+        super().__init__(f"non-finite loss {value!r} at step {step}")
+        self.step = int(step)
+        self.value = value
+        self.pos = pos
+
+
+class RollbackExhaustedError(RuntimeError):
+    """More than `max_rollbacks` consecutive NaN rollbacks — the divergence
+    is not a poisoned data window, something is structurally wrong (lr blowup,
+    corrupt state); a human has to look."""
+
+
+class DataQualityError(RuntimeError):
+    """The decode-failure rate crossed the configured abort threshold —
+    enough zero-canvas batches to poison training, so continuing would waste
+    the run silently."""

@@ -1,0 +1,108 @@
+"""Checkpoint integrity sidecars (tentpole part 2).
+
+A preempted or out-of-quota writer leaves PARTIAL checkpoint steps on
+disk; Orbax's `latest_step()` happily points at them and the restore
+crashes — which used to brick `--resume auto` entirely. After every
+finalized save we record a manifest (relative path, size, sha256 per
+file) in `<ckpt_dir>/.integrity/<step>.json`; `--resume auto` then walks
+back from the newest step to the newest step that VERIFIES (see
+`checkpoint.restore_with_fallback`).
+
+The manifest directory name starts with a dot so Orbax never mistakes it
+for a step; manifests are written atomically (tmp + rename) so the
+sidecar itself cannot be left half-written by the same fault class it
+guards against.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from moco_tpu.utils.logging import log_event
+
+INTEGRITY_DIRNAME = ".integrity"
+_CHUNK = 1 << 20
+
+
+def _digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(_CHUNK)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def manifest_path(ckpt_dir: str, step: int) -> str:
+    return os.path.join(
+        os.path.abspath(ckpt_dir), INTEGRITY_DIRNAME, f"{step}.json"
+    )
+
+
+def _walk_step_files(step_dir: str) -> list[str]:
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(step_dir):
+        for fname in filenames:
+            out.append(
+                os.path.relpath(os.path.join(dirpath, fname), step_dir)
+            )
+    return sorted(out)
+
+
+def write_manifest(ckpt_dir: str, step: int) -> dict:
+    """Record the finalized step's file inventory + digests. Must run AFTER
+    the save is finished (`mgr.wait_until_finished()`) — a manifest of an
+    in-flight save would certify garbage."""
+    step_dir = os.path.join(os.path.abspath(ckpt_dir), str(step))
+    files = {}
+    for rel in _walk_step_files(step_dir):
+        full = os.path.join(step_dir, rel)
+        files[rel] = {"size": os.path.getsize(full), "sha256": _digest(full)}
+    manifest = {"step": int(step), "files": files}
+    path = manifest_path(ckpt_dir, step)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, path)
+    return manifest
+
+
+def verify_step(ckpt_dir: str, step: int) -> str | None:
+    """None when the step's files match its manifest (or when no manifest
+    exists — pre-manifest checkpoints stay restorable, the restore itself is
+    then the only gate). A human-readable mismatch reason otherwise."""
+    path = manifest_path(ckpt_dir, step)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        return f"unreadable manifest {path}: {e}"
+    step_dir = os.path.join(os.path.abspath(ckpt_dir), str(step))
+    expected = manifest.get("files", {})
+    for rel, meta in expected.items():
+        full = os.path.join(step_dir, rel)
+        if not os.path.exists(full):
+            return f"missing file {rel}"
+        size = os.path.getsize(full)
+        if size != meta["size"]:
+            return f"size mismatch on {rel}: {size} != {meta['size']}"
+        if _digest(full) != meta["sha256"]:
+            return f"digest mismatch on {rel}"
+    actual = set(_walk_step_files(step_dir))
+    extra = actual - set(expected)
+    if extra:
+        # extra files are tolerated (a newer orbax may add bookkeeping), but
+        # note them — they can explain a later restore surprise
+        log_event(
+            "ckpt-verify",
+            f"step {step}: {len(extra)} file(s) not in manifest: "
+            f"{sorted(extra)[:4]}",
+        )
+    return None

@@ -1,0 +1,54 @@
+"""Every-step non-finite-loss detection (tentpole part 3).
+
+The old guard (`debug_nans` + a finiteness check at `print_freq`) noticed a
+NaN up to `print_freq - 1` steps late and then simply killed the run. The
+sentinel checks EVERY step with a one-step lag: step k's loss (still a
+device array) is held, and pulled to host while step k+1 executes — the
+host read overlaps device compute, so the pipeline never bubbles the way a
+same-step `float(loss)` would. On detection it raises
+`NonFiniteLossError(step)`; the driver answers with a bounded checkpoint
+rollback (`train.train`), not a crash.
+"""
+
+from __future__ import annotations
+
+import math
+
+from moco_tpu.resilience.errors import NonFiniteLossError
+from moco_tpu.utils.logging import log_event
+
+
+class NaNSentinel:
+    """Hold each step's loss for one step, then verify it is finite.
+
+    `observe(step, loss)` swaps the pending (step, loss) pair and checks the
+    previous one; `flush()` checks the final pending pair at epoch/run end so
+    the last step is never left unverified. `loss` may be a device array
+    (the normal case) or a plain float (chaos injection).
+    """
+
+    def __init__(self) -> None:
+        self._pending: tuple[int, object, tuple[int, int] | None] | None = None
+
+    def observe(self, step: int, loss,
+                pos: tuple[int, int] | None = None) -> None:
+        """`pos` is the `(epoch, batch_index)` the step consumed — carried
+        onto the error so the rollback can target the poisoned batch without
+        step arithmetic (which breaks once skips have drifted the mapping)."""
+        prev, self._pending = self._pending, (int(step), loss, pos)
+        if prev is not None:
+            self._check(*prev)
+
+    def flush(self) -> None:
+        prev, self._pending = self._pending, None
+        if prev is not None:
+            self._check(*prev)
+
+    def _check(self, step: int, loss, pos: tuple[int, int] | None) -> None:
+        value = float(loss)
+        if not math.isfinite(value):
+            log_event(
+                "sentinel",
+                f"non-finite loss {value!r} at step {step}; requesting rollback",
+            )
+            raise NonFiniteLossError(step, value, pos)

@@ -1,0 +1,90 @@
+"""Step-time watchdog (tentpole part 5).
+
+A stuck collective on a pod (one host preempted mid-all-reduce, a wedged
+DCN link) looks like SILENCE from the driver: the step never completes, no
+exception fires, and a multi-day run burns quota doing nothing. The
+watchdog is a background thread that flags — loudly, and again every
+further interval — when no `beat()` has arrived within the configured
+window. It deliberately only FLAGS (via `log_event`): killing the process
+from a watchdog thread would turn a transient stall into data loss; the
+operator (or the surrounding orchestration reading the log) decides.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from moco_tpu.utils.logging import log_event
+
+
+class StepWatchdog:
+    """Context manager; `beat(step)` after every completed train step.
+
+    `interval_secs <= 0` disables the thread entirely — `beat` stays a cheap
+    attribute write so callers need no gating. `stalls` counts flags raised
+    (testable without log scraping).
+    """
+
+    def __init__(self, interval_secs: float):
+        self.interval = float(interval_secs)
+        self.stalls = 0
+        self._suspend = 0
+        self._step = 0
+        self._last = time.monotonic()
+        # re-arm threshold: after flagging once, flag again only after a
+        # FURTHER full interval of silence (one line per interval, not per poll)
+        self._warn_after = self.interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def beat(self, step: int) -> None:
+        self._step = int(step)
+        self._last = time.monotonic()
+        self._warn_after = self.interval
+
+    @contextlib.contextmanager
+    def suspended(self):
+        """Scope for KNOWN-long non-step work (epoch-boundary kNN eval, a
+        blocking save): a flag fired there is a false positive that trains
+        operators to ignore the real ones. Re-arms fresh on exit. Safe when
+        the watchdog is disabled; nests."""
+        self._suspend += 1
+        try:
+            yield
+        finally:
+            self._suspend -= 1
+            self._last = time.monotonic()
+            self._warn_after = self.interval
+
+    def _watch(self) -> None:
+        poll = max(self.interval / 4.0, 0.01)
+        while not self._stop.wait(poll):
+            if self._suspend:
+                continue
+            gap = time.monotonic() - self._last
+            if gap > self._warn_after:
+                self.stalls += 1
+                self._warn_after += self.interval
+                log_event(
+                    "watchdog",
+                    f"no step completed in {gap:.1f}s (last completed step "
+                    f"{self._step}, threshold {self.interval:.1f}s) — "
+                    "possible hang (stuck collective / wedged input pipeline)",
+                )
+
+    def __enter__(self) -> "StepWatchdog":
+        if self.interval > 0:
+            self._last = time.monotonic()
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._watch, daemon=True)
+            self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        return False

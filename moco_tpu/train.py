@@ -14,6 +14,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import time
@@ -22,7 +23,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from moco_tpu.checkpoint import checkpoint_manager, maybe_resume, save_checkpoint
+from moco_tpu.checkpoint import (
+    checkpoint_manager,
+    finalize_checkpoints,
+    maybe_resume,
+    read_position,
+    save_checkpoint,
+)
 from moco_tpu.config import PRESETS, PretrainConfig, get_preset
 from moco_tpu.data import (
     aug_config_for,
@@ -32,10 +39,22 @@ from moco_tpu.data import (
 )
 from moco_tpu.ops.knn import knn_accuracy
 from moco_tpu.parallel.mesh import create_mesh, local_batch_size
+from moco_tpu.resilience import (
+    DataQualityError,
+    NaNSentinel,
+    NonFiniteLossError,
+    PreemptionHandler,
+    RollbackExhaustedError,
+    StepWatchdog,
+    active_chaos,
+    clear_chaos,
+    install_chaos,
+    parse_chaos_spec,
+)
 from moco_tpu.train_state import create_train_state
 from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
-from moco_tpu.utils.logging import ProfilerWindow, ScalarWriter
-from moco_tpu.utils.meters import AverageMeter, ProgressMeter, Throughput
+from moco_tpu.utils.logging import ProfilerWindow, ScalarWriter, log_event
+from moco_tpu.utils.meters import AverageMeter, ProgressMeter, RateMeter, Throughput
 
 
 def make_feature_fn(model, variant: str):
@@ -172,9 +191,89 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
 
     `dataset` overrides the config-built one (callers that need a custom
     size/source, e.g. the horizon runs, without widening the flag surface).
+
+    Fault tolerance (resilience/): SIGTERM/SIGINT finishes the in-flight
+    step, writes an emergency checkpoint, and returns cleanly; a non-finite
+    loss triggers a bounded rollback — restore the last good checkpoint,
+    advance the data stream past the poisoned window, and retry, aborting
+    with `RollbackExhaustedError` only after `config.max_rollbacks`
+    consecutive rollbacks that make no net progress. Note a rollback
+    intentionally alters the data stream, so the post-rollback trajectory is
+    no longer bit-identical to an uninterrupted run (preemption resume IS).
     """
     if mesh is None:
         mesh = create_mesh()
+    installed_chaos = False
+    if config.chaos:
+        if active_chaos() is None:
+            install_chaos(parse_chaos_spec(config.chaos))
+            installed_chaos = True
+        else:
+            # an already-active plan (chaos_context in tests, or a
+            # MOCO_TPU_CHAOS env plan) wins — its fire-once state must not
+            # be clobbered mid-scenario — but say so LOUDLY: an operator's
+            # --chaos drill silently exercising someone else's faults would
+            # be vacuous
+            log_event(
+                "chaos",
+                f"--chaos {config.chaos!r} IGNORED: a plan is already "
+                f"active for this process ({active_chaos()!r}) — unset "
+                "MOCO_TPU_CHAOS to use the CLI spec",
+            )
+    rollbacks = 0
+    last_nan_step = -1
+    data_advance = 0
+    poison_pos = None
+    run_config = config
+    try:
+        while True:
+            try:
+                return _train_once(run_config, mesh, max_steps, dataset,
+                                   data_advance=data_advance,
+                                   poison_pos=poison_pos)
+            except NonFiniteLossError as e:
+                if not config.ckpt_dir or config.max_rollbacks <= 0:
+                    raise
+                # "consecutive" = no net progress: a NaN at or before the
+                # last poisoned step means the run never got past it
+                rollbacks = rollbacks + 1 if e.step <= last_nan_step else 1
+                last_nan_step = max(last_nan_step, e.step)
+                if rollbacks > config.max_rollbacks:
+                    raise RollbackExhaustedError(
+                        f"{rollbacks} consecutive rollbacks without progress "
+                        f"past step {last_nan_step} (max_rollbacks="
+                        f"{config.max_rollbacks}): the divergence is "
+                        "structural, not a poisoned data window — aborting "
+                        "for a human"
+                    ) from e
+                log_event(
+                    "rollback",
+                    f"non-finite loss at step {e.step}: restoring the last "
+                    f"good checkpoint and advancing the data stream past the "
+                    f"poisoned window (rollback {rollbacks}/"
+                    f"{config.max_rollbacks})",
+                )
+                run_config = config.replace(resume="auto")
+                data_advance = e.step
+                poison_pos = e.pos
+    finally:
+        if installed_chaos:
+            # a plan left installed would hijack the NEXT train() call in
+            # this process: its own --chaos spec would be silently ignored
+            # (a vacuous drill), or this run's unspent faults would fire
+            # into it
+            clear_chaos()
+
+
+def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
+                dataset=None, data_advance: int = 0,
+                poison_pos: tuple[int, int] | None = None):
+    """One driver pass (the body `train` retries around on rollback).
+    `data_advance`: skip the data stream forward past the poisoned window —
+    weights restart from the restored checkpoint but the window is never
+    re-consumed. `poison_pos` is the `(epoch, batch_index)` the poisoned
+    batch was consumed at; when absent it is derived from `data_advance`
+    (only correct while steps and batches are still aligned)."""
     if config.knn_monitor and config.knn_every_epochs < 1:
         raise ValueError(
             f"knn_every_epochs must be >= 1 (got {config.knn_every_epochs}); "
@@ -255,13 +354,43 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
     # host-side step counter mirroring state.step: int(state.step) would be a
     # device→host sync (~70 ms on the relay) serializing every iteration
     global_step = int(state.step)
-    start_epoch = global_step // steps_per_epoch
-    # a checkpoint saved after a mid-epoch max_steps break has step not
-    # divisible by steps_per_epoch; skip the resumed epoch's already-consumed
-    # batches so no data is replayed and epoch boundaries stay aligned with
-    # state.step (the epoch_loader permutation is deterministic per epoch, so
-    # batch i here is bit-identical to batch i of the interrupted run)
-    resume_skip = global_step % steps_per_epoch
+    # data-stream position: prefer the checkpoint's position sidecar — step
+    # arithmetic replays consumed batches once a NaN rollback's data-window
+    # skip has drifted the step↔batch mapping. Arithmetic remains the
+    # fallback for sidecar-less checkpoints (pre-feature, or lost to a
+    # mid-save kill): skip the resumed epoch's already-consumed batches so
+    # no data is replayed (the epoch_loader permutation is deterministic per
+    # epoch, so batch i here is bit-identical to batch i of the interrupted
+    # run)
+    pos = (read_position(config.ckpt_dir, global_step)
+           if config.ckpt_dir and global_step else None)
+    if pos is not None:
+        start_epoch, resume_skip = pos
+    else:
+        start_epoch = global_step // steps_per_epoch
+        resume_skip = global_step % steps_per_epoch
+    poison_epoch = poison_batch = None
+    if data_advance > global_step:
+        # NaN rollback: weights restart from the restored step, but the data
+        # stream must not replay the poisoned window — every batch from the
+        # restore point THROUGH the poisoned batch is skipped, across epoch
+        # boundaries when the restored checkpoint is older than the poison's
+        # epoch (ckpt_every_epochs > 1, or an integrity walk-back past a
+        # corrupt save). Skipped epochs yield fewer steps than
+        # steps_per_epoch, so the run's step count drifts from epoch
+        # alignment — accepted: the trajectory already diverged the moment
+        # data was skipped.
+        if poison_pos is not None:
+            poison_epoch, poison_batch = poison_pos
+        else:
+            poison_epoch = (data_advance - 1) // steps_per_epoch
+            poison_batch = (data_advance - 1) % steps_per_epoch
+        log_event(
+            "rollback",
+            f"advancing the data stream past the poisoned window: restored "
+            f"step {global_step}, skipping through batch {poison_batch} of "
+            f"epoch {poison_epoch}",
+        )
     total_steps = max_steps or config.epochs * steps_per_epoch
     last_metrics: dict = {}
     baseline_metrics: dict = {}
@@ -270,6 +399,7 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
     # observability on process 0 only: every host writing the same tags into
     # one tb_dir duplicates curves, and concurrent profiler traces race
     is_main = jax.process_index() == 0
+    n_procs = jax.process_count()
     writer = ScalarWriter(config.tb_dir if is_main else "")
     profiler = ProfilerWindow(
         config.profile_dir if is_main else "", config.profile_start, config.profile_stop
@@ -335,6 +465,14 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
                 flush=True,
             )
 
+    # resilience hooks (ISSUE 1): signal-flag preemption, every-step NaN
+    # sentinel (one-step lag), hang watchdog, decode-failure meter, chaos
+    plan = active_chaos()
+    sentinel = NaNSentinel() if config.loss_sentinel else None
+    preempted = False
+    _resilience = contextlib.ExitStack()
+    preempt = _resilience.enter_context(PreemptionHandler())
+    watchdog = _resilience.enter_context(StepWatchdog(config.watchdog_secs))
     try:
         for epoch in range(start_epoch, config.epochs):
             if done:
@@ -344,16 +482,25 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
             losses = AverageMeter("Loss", ":.4e")
             top1 = AverageMeter("Acc@1", ":6.2f")
             top5 = AverageMeter("Acc@5", ":6.2f")
+            decode_fail = RateMeter("DecFail")
             progress = ProgressMeter(
                 steps_per_epoch,
-                [batch_time, data_time, losses, top1, top5],
+                [batch_time, data_time, losses, top1, top5, decode_fail],
                 prefix=f"Epoch: [{epoch}]",
             )
             throughput = Throughput(n_chips)
             skip = resume_skip if epoch == start_epoch else 0
+            if poison_epoch is not None and epoch <= poison_epoch:
+                # inside the poisoned window: epochs before the poison's are
+                # skipped wholesale, the poison's own epoch through the
+                # poisoned batch itself
+                skip = steps_per_epoch if epoch < poison_epoch else max(
+                    skip, poison_batch + 1)
+            epoch_start_step = global_step
             loader = epoch_loader(
                 dataset, epoch, config.seed, config.batch_size, mesh,
-                skip_batches=skip,
+                skip_batches=skip, retries=config.loader_retries,
+                backoff_secs=config.loader_backoff_secs,
             )
             end = time.perf_counter()
             try:
@@ -364,6 +511,50 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
                     profiler.maybe_toggle(global_step)
                     state, metrics = fused_step(state, imgs, extents, global_step)
                     global_step += 1
+                    if plan is not None and plan.maybe_nan(global_step):
+                        # emulate a real divergence end-to-end: the NaN flows
+                        # through the same metrics dict the sentinel/meters see
+                        metrics = dict(metrics, loss=float("nan"))
+                    if sentinel is not None:
+                        sentinel.observe(global_step, metrics["loss"],
+                                         pos=(epoch, i))
+                    watchdog.beat(global_step)
+                    d_fail = getattr(dataset, "decode_failures", 0)
+                    d_total = getattr(dataset, "decode_total", 0)
+                    # per-host fault signals (SIGTERM flag, decode counters)
+                    # must be ACTED on identically everywhere: one host
+                    # raising or breaking alone leaves the rest hung in the
+                    # next collective. Multi-host runs agree on them at a
+                    # fixed step cadence; single-host acts immediately.
+                    preempt_agreed = False
+                    abort_fail, abort_total = d_fail, d_total
+                    if n_procs > 1:
+                        abort_fail = abort_total = 0
+                        if (config.resilience_sync_steps > 0 and
+                                global_step % config.resilience_sync_steps == 0):
+                            from jax.experimental import multihost_utils
+
+                            agg = multihost_utils.process_allgather(
+                                np.asarray(
+                                    [int(preempt.triggered), d_fail, d_total],
+                                    np.int64,
+                                )
+                            )
+                            preempt_agreed = bool(agg[:, 0].max())
+                            abort_fail = int(agg[:, 1].sum())
+                            abort_total = int(agg[:, 2].sum())
+                    if (
+                        config.decode_abort_rate
+                        and abort_total >= config.batch_size
+                        and abort_fail / abort_total > config.decode_abort_rate
+                    ):
+                        raise DataQualityError(
+                            f"decode-failure rate {abort_fail}/{abort_total} = "
+                            f"{abort_fail / abort_total:.1%} exceeds "
+                            f"decode_abort_rate={config.decode_abort_rate:.1%}: "
+                            "training on zero canvases would silently waste "
+                            "the run"
+                        )
                     if i % config.print_freq == 0:
                         # pull metrics (host sync) only when printing
                         last_metrics = {k: float(v) for k, v in metrics.items()}
@@ -374,6 +565,7 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
                         losses.update(last_metrics["loss"], config.batch_size)
                         top1.update(last_metrics.get("acc1", 0.0), config.batch_size)
                         top5.update(last_metrics.get("acc5", 0.0), config.batch_size)
+                        decode_fail.update(d_fail, d_total)
                         progress.display(i)
                         writer.write(
                             global_step,
@@ -381,16 +573,40 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
                                 last_metrics,
                                 imgs_per_sec=throughput.imgs_per_sec,
                                 imgs_per_sec_per_chip=throughput.imgs_per_sec_per_chip,
+                                decode_failures=d_fail,
+                                decode_failure_rate=decode_fail.rate,
                             ),
                         )
                     throughput.update(config.batch_size)
                     batch_time.update(time.perf_counter() - end)
                     end = time.perf_counter()
+                    if plan is not None:
+                        plan.maybe_sigterm(global_step)
+                    if preempt_agreed or (n_procs == 1 and preempt.triggered):
+                        # finish-the-step-then-exit: the emergency checkpoint
+                        # (a COLLECTIVE save) lands after the loop, at a step
+                        # every host agrees on — a signaled host breaking by
+                        # itself would leave the others in a hung collective
+                        preempted = True
+                        done = True
+                        break
                     if global_step >= total_steps:
                         done = True
                         break
             finally:
-                loader.close()  # unblock the prefetch thread on early break
+                # unblock the prefetch thread on early break; quietly — a
+                # pending staged-read error raised here would replace an
+                # in-flight exception (disarming the NaN rollback) or void a
+                # completed/preempted run whose every consumed step succeeded
+                loader.close_quietly()
+            if sentinel is not None:
+                # check the epoch's LAST loss now (its one-step-lag check
+                # would otherwise land after the epoch-end save below, and a
+                # NaN state would be checkpointed — then restored by the very
+                # rollback trying to escape it)
+                sentinel.flush()
+            if preempted:
+                break  # no epoch eval/save: the emergency checkpoint follows
             print(
                 f"Epoch [{epoch}] imgs/sec {throughput.imgs_per_sec:.1f} "
                 f"({throughput.imgs_per_sec_per_chip:.1f}/chip)",
@@ -398,16 +614,22 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
             )
             # cadence: every knn_every_epochs, plus the run's final epoch
             # (early `done` break included) so end-of-run gates always see a
-            # current number
-            if config.knn_monitor and (
+            # current number. Zero-step epochs (a rollback skipped them
+            # wholesale) have nothing new to report: the weights are
+            # unchanged, so the eval would burn minutes re-measuring the
+            # previous point and write a duplicate at the same global_step
+            if config.knn_monitor and global_step > epoch_start_step and (
                 (epoch + 1) % config.knn_every_epochs == 0
                 or epoch == config.epochs - 1
                 or done
             ):
-                acc, is_val = knn_monitor(
-                    config, feature_fn, state, dataset, mesh,
-                    val_dataset=monitor_val,
-                )
+                with watchdog.suspended():
+                    # a multi-minute eval with no step beats is a guaranteed
+                    # false 'possible hang' flag otherwise
+                    acc, is_val = knn_monitor(
+                        config, feature_fn, state, dataset, mesh,
+                        val_dataset=monitor_val,
+                    )
                 # with a real val split the tag is a true val metric;
                 # otherwise the held-out slice comes from the TRAIN set and
                 # the tag says so, to avoid misreading it
@@ -417,18 +639,51 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
                 print(f"Epoch [{epoch}] kNN({label}) top-1 {100 * acc:.2f}%",
                       flush=True)
                 writer.write(global_step, {tag: acc})
-            if mgr is not None and (epoch + 1) % config.ckpt_every_epochs == 0:
-                # unlike the reference's rank-0-only torch.save, Orbax saving of
-                # multi-process arrays is COLLECTIVE — every process must call it
-                save_checkpoint(mgr, state, global_step)
+            if (
+                mgr is not None
+                and global_step > epoch_start_step  # an epoch the rollback
+                # skipped wholesale made no progress — re-saving the restored
+                # step would collide with the existing checkpoint
+                and (epoch + 1) % config.ckpt_every_epochs == 0
+            ):
+                # unlike the reference's rank-0-only torch.save, Orbax saving
+                # of multi-process arrays is COLLECTIVE — every process must
+                # call it. Async (wait=False): serialization overlaps the
+                # next epoch's compute; the integrity manifest is deferred to
+                # the next save / finalize_checkpoints
+                save_checkpoint(mgr, state, global_step, wait=False,
+                                position=(epoch + 1, 0))
+        if sentinel is not None:
+            # the final step's loss is still pending (one-step lag)
+            sentinel.flush()
     finally:
         # always land the profiler trace and flush buffered scalars,
-        # even when the loop raises (debug_nans, data errors, ^C)
+        # even when the loop raises (debug_nans, data errors, ^C);
+        # restore signal dispositions and stop the watchdog thread
+        _resilience.close()
         profiler.close()
         writer.close()
+        if mgr is not None:
+            # commit any in-flight async epoch save (and its deferred
+            # manifest) BEFORE a rollback's restore walks the directory —
+            # otherwise "latest" may be a step Orbax is still writing
+            finalize_checkpoints(mgr)
+    if preempted and mgr is not None:
+        # step-tagged emergency checkpoint: the position sidecar (plus the
+        # mid-epoch `resume_skip` path) makes the resumed run bit-identical
+        # to the uninterrupted one. `epoch`/`i` survive the loop: the
+        # preempted break only fires inside an iteration
+        emergency_pos = ((epoch + 1, 0) if i + 1 >= steps_per_epoch
+                         else (epoch, i + 1))
+        log_event(
+            "preempt",
+            f"writing emergency checkpoint at step {global_step}, then "
+            "exiting cleanly",
+        )
+        save_checkpoint(mgr, state, global_step, position=emergency_pos)
     if mgr is not None:
-        mgr.wait_until_finished()
-    if config.export_path and is_main:
+        finalize_checkpoints(mgr)
+    if config.export_path and is_main and not preempted:
         # close the pretrain→probe loop: v1/v2 write the query encoder in the
         # reference checkpoint dialect (torchvision names) for evals.lincls /
         # evals.knn / export_detectron2; v3 writes its backbone tree dialect

@@ -42,6 +42,8 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
         # without this flag still has the cache ON via the dir above, so
         # the return value must say enabled either way
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
+    except (AttributeError, ValueError):
+        # older jax: the threshold flag doesn't exist — the cache itself
+        # stays enabled via the dir set above
         pass
     return path

@@ -1,0 +1,73 @@
+"""Version compatibility shims for the JAX API surface this repo uses.
+
+The codebase targets the current public API (`jax.shard_map`,
+`lax.axis_size`); older installed builds (0.4.x) ship the same
+functionality under experimental/derived spellings. Routing every call
+site through this module keeps the rest of the code written against ONE
+(modern) API while still importing cleanly on the toolchain the
+container actually has — the same stub-don't-install stance the repo
+takes for optional deps.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # 0.4.x: public promotion landed later
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+# Modern jax defaults to partitionable threefry; 0.4.x defaulted to the
+# original lowering, whose stream DIFFERS and is not invariant under
+# sharding. The repo's recorded trajectories (tests/test_golden.py) and the
+# sharded-equals-unsharded contracts assume the modern stream, so pin it.
+if hasattr(jax.config, "jax_threefry_partitionable"):
+    jax.config.update("jax_threefry_partitionable", True)
+
+
+def axis_size(axis_name) -> jax.Array | int:
+    """`lax.axis_size` (new API), or the psum-of-ones equivalent inside a
+    mapped context on builds that predate it."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+import functools  # noqa: E402
+import inspect  # noqa: E402
+
+_SDS_HAS_VMA = "vma" in inspect.signature(jax.ShapeDtypeStruct.__init__).parameters
+
+
+@functools.lru_cache(maxsize=1)
+def _barrier_batchable() -> bool:
+    import jax.numpy as jnp
+
+    try:
+        jax.vmap(lax.optimization_barrier)(jnp.zeros((2, 1)))
+        return True
+    except NotImplementedError:  # 0.4.x: no batching rule for the barrier
+        return False
+
+
+def optimization_barrier(x):
+    """`lax.optimization_barrier`, or identity on builds whose barrier has no
+    vmap batching rule (0.4.x). The barrier is a scheduling hint — dropping
+    it changes compile determinism, never numerics — so identity is the
+    correct degradation, and it is applied uniformly (also outside vmap) so
+    a given build always compiles the same program."""
+    if _barrier_batchable():
+        return lax.optimization_barrier(x)
+    return x
+
+
+def shape_dtype_struct(shape, dtype, vma=frozenset()) -> jax.ShapeDtypeStruct:
+    """`jax.ShapeDtypeStruct` with the varying-manual-axes annotation where
+    the build supports it (pallas outputs inside shard_map must declare
+    their vma explicitly on new jax). On 0.4.x the concept doesn't exist,
+    so dropping the annotation is the correct lowering, not a loss."""
+    if _SDS_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)

@@ -8,6 +8,14 @@ TensorBoard's profile plugin for MXU/HBM analysis."""
 from __future__ import annotations
 
 
+def log_event(kind: str, msg: str) -> None:
+    """One-line structured event log (`[kind] msg`, flushed) — the channel
+    the resilience subsystem reports through. A fixed `[kind]` prefix keeps
+    preemption/rollback/chaos events greppable in multi-day run logs, where
+    they would otherwise drown in the per-step meter lines."""
+    print(f"[{kind}] {msg}", flush=True)
+
+
 class ScalarWriter:
     """tensorboardX SummaryWriter wrapper; silently no-ops when `logdir` is
     empty or tensorboardX is unavailable."""

@@ -47,6 +47,27 @@ class ProgressMeter:
         print("\t".join(entries), flush=True)
 
 
+class RateMeter:
+    """Cumulative event count over attempts, printed `name n (rate%)` — the
+    decode-failure monitor surface (ISSUE 1: zero-canvas batches must be
+    visible in the per-step meter line, not a discarded return value)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0
+
+    def update(self, count: int, total: int):
+        self.count, self.total = int(count), int(total)
+
+    @property
+    def rate(self) -> float:
+        return self.count / self.total if self.total else 0.0
+
+    def __str__(self):
+        return f"{self.name} {self.count} ({100.0 * self.rate:.2f}%)"
+
+
 class Throughput:
     """imgs/sec (global and per-chip) over a rolling window."""
 

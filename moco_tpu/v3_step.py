@@ -33,6 +33,7 @@ from moco_tpu.ops.losses import l2_normalize, v3_contrastive_loss
 from moco_tpu.parallel.collectives import all_gather_batch
 from moco_tpu.parallel.mesh import DATA_AXIS
 from moco_tpu.train_state import TrainState
+from moco_tpu.utils.compat import shard_map
 
 PREDICTOR_KEY = "predictor"
 
@@ -156,7 +157,7 @@ def build_v3_train_step(
         )
         return grads, new_stats_q, new_stats_k, metrics
 
-    region = jax.shard_map(
+    region = shard_map(
         spmd_region,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),

@@ -8,11 +8,12 @@ from jax.sharding import PartitionSpec as P
 
 from moco_tpu.parallel import DATA_AXIS, batch_shuffle, batch_unshuffle
 from moco_tpu.parallel.collectives import all_gather_batch, ring_shuffle
+from moco_tpu.utils.compat import shard_map
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
     return jax.jit(
-        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     )
 
 

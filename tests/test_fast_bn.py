@@ -10,6 +10,7 @@ import pytest
 
 from moco_tpu.models.fast_bn import FastBatchNorm
 from moco_tpu.ops.pallas_stats import channel_grad_sums, channel_sums
+from moco_tpu.utils.compat import shard_map
 
 
 def _pair(dtype):
@@ -94,7 +95,7 @@ def test_fast_bn_sync_axis(mesh8):
         return y, mut["batch_stats"]["mean"]
 
     y, mean = jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh8, in_specs=P("data"), out_specs=(P("data"), P()),
         )
     )(x)

@@ -11,6 +11,7 @@ Three layers of proof, all CPU-runnable:
 
 import flax.linen as nn
 import jax
+import jax.export  # noqa: F401  (binds the lazy submodule on 0.4.x)
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -1,0 +1,48 @@
+"""tools/lint_robustness.py in tier-1: the package must stay free of bare
+`except:` / broad silent swallowing (they would quietly defeat the
+resilience subsystem's typed-error routing), and the linter itself must
+keep catching both patterns."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "tools", "lint_robustness.py")
+
+spec = importlib.util.spec_from_file_location("lint_robustness", LINT)
+lint = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(lint)
+
+
+def test_package_is_clean():
+    assert lint.check_tree(os.path.join(REPO, "moco_tpu")) == []
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = subprocess.run(
+        [sys.executable, LINT, os.path.join(REPO, "moco_tpu")],
+        capture_output=True, text=True,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    (tmp_path / "dirty.py").write_text(
+        "try:\n    x = 1\nexcept:\n    pass\n"
+    )
+    dirty = subprocess.run(
+        [sys.executable, LINT, str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert dirty.returncode == 1
+    assert "bare `except:`" in dirty.stdout
+
+
+def test_detects_broad_silent_swallow(tmp_path):
+    (tmp_path / "swallow.py").write_text(
+        "try:\n    x = 1\nexcept Exception:\n    pass\n"
+        "try:\n    y = 2\nexcept (ValueError, OSError):\n    pass\n"  # legal
+        "try:\n    z = 3\nexcept Exception as e:\n    print(e)\n"     # legal
+    )
+    found = lint.check_file(str(tmp_path / "swallow.py"))
+    assert len(found) == 1
+    assert ":3:" in found[0] and "silently swallows" in found[0]

@@ -13,6 +13,7 @@ from moco_tpu.ops.losses import (
     v3_contrastive_loss,
 )
 from moco_tpu.parallel import DATA_AXIS
+from moco_tpu.utils.compat import shard_map
 
 
 def _rand_unit(key, shape):
@@ -100,6 +101,6 @@ def test_v3_loss_sharded_matches_single_device(mesh8):
         return jax.lax.pmean(loss, DATA_AXIS)
 
     sharded = jax.jit(
-        jax.shard_map(f, mesh=mesh8, in_specs=(P(DATA_AXIS), P(DATA_AXIS)), out_specs=P())
+        shard_map(f, mesh=mesh8, in_specs=(P(DATA_AXIS), P(DATA_AXIS)), out_specs=P())
     )(q, k)
     np.testing.assert_allclose(float(sharded), ref, rtol=1e-5)

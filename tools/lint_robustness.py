@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Forbid silent exception swallowing in moco_tpu/ (ISSUE 1 tooling).
+
+The fault-tolerance subsystem only works if faults are VISIBLE: a bare
+`except:` (which eats KeyboardInterrupt/SystemExit and hides the
+preemption path) or an `except Exception: pass` (which discards the very
+errors the retry/rollback machinery routes on) would quietly defeat it.
+
+Rules, AST-enforced over every .py file under the package:
+
+  R1  no bare `except:` handlers;
+  R2  no handler over `Exception`/`BaseException` whose body is only
+      `pass`/`...` — swallowing EVERYTHING silently is never a policy.
+      Narrow named exceptions (`except (AttributeError, ValueError): pass`)
+      stay legal: deliberately ignoring a specific, expected failure is a
+      policy the type spells out.
+
+Exit 0 when clean; exit 1 with one `path:line: message` per violation.
+Runs in tier-1 via tests/test_lint_robustness.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _names(node: ast.expr | None):
+    """Exception class names a handler catches (dotted tails included)."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Tuple):
+        return [n for elt in node.elts for n in _names(elt)]
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    return []
+
+
+def _silent(body: list[ast.stmt]) -> bool:
+    return all(
+        isinstance(stmt, ast.Pass)
+        or (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+        for stmt in body
+    )
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: unparseable ({e.msg})"]
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append(
+                f"{path}:{node.lineno}: bare `except:` — name the exception "
+                "types (a bare handler hides SIGINT and the preemption path)"
+            )
+        elif _silent(node.body) and BROAD & set(_names(node.type)):
+            out.append(
+                f"{path}:{node.lineno}: `except "
+                f"{'/'.join(sorted(BROAD & set(_names(node.type))))}` with a "
+                "pass-only body silently swallows every error — narrow the "
+                "type or handle/log it"
+            )
+    return out
+
+
+def check_tree(root: str) -> list[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                out.extend(check_file(os.path.join(dirpath, fname)))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "moco_tpu"
+    )
+    violations = check_tree(root)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} robustness violation(s) in {root}")
+        return 1
+    print(f"robustness lint clean: {root}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
