@@ -94,6 +94,23 @@ class PretrainConfig:
     profile_start: int = 10           # trace window [start, stop) in steps
     profile_stop: int = 20
     debug_nans: bool = False          # jax_debug_nans + finite-loss guard (§5.2)
+    # structured run telemetry (telemetry/; ISSUE 2) — machine-readable
+    # step-phase timing, MFU, HBM tracking, pod-aggregated JSONL events
+    telemetry_dir: str = ""           # events.jsonl + heartbeat.json land
+                                      # here ("" = telemetry off; no step-
+                                      # loop overhead when off)
+    telemetry_flush_steps: int = 50   # buffered-record flush (+ heartbeat)
+                                      # cadence, in step records
+    telemetry_stride: int = 16        # device-fence sampling stride: every
+                                      # N steps block_until_ready measures
+                                      # the device-compute phase and HBM is
+                                      # sampled; all other steps stay fully
+                                      # async (0 = never fence)
+    peak_flops_per_chip: float = 0.0  # MFU denominator override; 0 = look
+                                      # up device_kind in the bf16 peak
+                                      # table (telemetry/mfu.py; unknown
+                                      # hardware ⇒ MFU omitted, never
+                                      # fabricated)
     ckpt_dir: str = "checkpoints"
     ckpt_every_epochs: int = 1
     resume: str = ""                  # path | "auto"

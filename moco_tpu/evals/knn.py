@@ -105,7 +105,9 @@ def run_knn(config: EvalConfig, mesh=None) -> float:
         temperature=config.knn_temperature,
         bank_chunk=config.knn_bank_chunk or None,
     )
-    print(f"kNN top-1: {100 * acc:.2f}% (k={config.knn_k}, T={config.knn_temperature})")
+    from moco_tpu.utils.logging import info
+
+    info(f"kNN top-1: {100 * acc:.2f}% (k={config.knn_k}, T={config.knn_temperature})")
     return acc
 
 

@@ -42,6 +42,7 @@ from moco_tpu.models import build_resnet
 from moco_tpu.ops.losses import contrastive_accuracy
 from moco_tpu.ops.schedules import cosine_lr, step_lr
 from moco_tpu.parallel.mesh import create_mesh, local_batch_size
+from moco_tpu.utils.logging import info
 from moco_tpu.utils.meters import AverageMeter, ProgressMeter
 
 
@@ -277,7 +278,7 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
         # the (resumed) probe, no training (`main_lincls.py:≈L95, ≈L280`)
         acc1, acc5 = validate(eval_step, fc, backbone_params, backbone_stats,
                               val_set, config, mesh)
-        print(f"Evaluate: val Acc@1 {acc1:.2f} Acc@5 {acc5:.2f}", flush=True)
+        info(f"Evaluate: val Acc@1 {acc1:.2f} Acc@5 {acc5:.2f}")
         return fc, acc1
 
     for epoch in range(start_epoch, config.epochs):
@@ -308,8 +309,7 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
         acc1, acc5 = validate(eval_step, fc, backbone_params, backbone_stats,
                               val_set, config, mesh)
         best_acc1 = max(best_acc1, acc1)
-        print(f"Epoch [{epoch}] val Acc@1 {acc1:.2f} Acc@5 {acc5:.2f} (best {best_acc1:.2f})",
-              flush=True)
+        info(f"Epoch [{epoch}] val Acc@1 {acc1:.2f} Acc@5 {acc5:.2f} (best {best_acc1:.2f})")
         if mgr is not None:
             import orbax.checkpoint as ocp
 
@@ -395,9 +395,9 @@ def main(argv=None):
 
         force_cpu_devices(args.fake_devices)
     config = get_preset(args.preset).replace(**collect_overrides(args, EvalConfig))
-    print(f"config: {config}")
+    info(f"config: {config}")
     _, best = train_lincls(config, max_steps=args.max_steps)
-    print(f"best val Acc@1: {best:.2f}")
+    info(f"best val Acc@1: {best:.2f}")
 
 
 if __name__ == "__main__":

@@ -96,7 +96,9 @@ def main(argv=None):
     parser.add_argument("--prefix", default="module.encoder_q.")
     args = parser.parse_args(argv)
     model = convert(args.input, args.output, args.prefix)
-    print(f"wrote {args.output} with {len(model)} tensors")
+    from moco_tpu.utils.logging import info
+
+    info(f"wrote {args.output} with {len(model)} tensors")
 
 
 if __name__ == "__main__":

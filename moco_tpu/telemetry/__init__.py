@@ -1,0 +1,60 @@
+"""Structured run telemetry (ISSUE 2 tentpole).
+
+The measurement foundation every perf PR is judged against: step-phase
+timing (data/host/device), analytic-FLOPs MFU, HBM/host-memory tracking,
+pod-aggregated JSONL events, a heartbeat for external watchdogs, and the
+`log_event` bridge that lands resilience incidents in the same stream.
+
+Offline consumer: `tools/telemetry_report.py` renders p50/p95/p99 step
+time, MFU, throughput, HBM high-water and incident counts from an
+events.jsonl. Schema notes: registry.py module docstring + README
+"Observability".
+"""
+
+from moco_tpu.telemetry.device import DeviceMonitor, host_rss_bytes
+from moco_tpu.telemetry.mfu import (
+    MFUEstimator,
+    detect_peak_flops,
+    model_fwd_flops,
+    resnet_fwd_flops,
+    train_step_flops,
+    vit_fwd_flops,
+)
+from moco_tpu.telemetry.pod import POD_FIELDS, PodAggregator
+from moco_tpu.telemetry.registry import (
+    EVENTS_FILENAME,
+    HEARTBEAT_FILENAME,
+    SCHEMA_VERSION,
+    Counter,
+    Gauge,
+    Heartbeat,
+    Histogram,
+    MetricsRegistry,
+    percentiles_ms,
+)
+from moco_tpu.telemetry.run import RunTelemetry
+from moco_tpu.telemetry.timing import StepPhaseTimer
+
+__all__ = [
+    "Counter",
+    "DeviceMonitor",
+    "EVENTS_FILENAME",
+    "Gauge",
+    "HEARTBEAT_FILENAME",
+    "Heartbeat",
+    "Histogram",
+    "MFUEstimator",
+    "MetricsRegistry",
+    "POD_FIELDS",
+    "PodAggregator",
+    "RunTelemetry",
+    "SCHEMA_VERSION",
+    "StepPhaseTimer",
+    "detect_peak_flops",
+    "host_rss_bytes",
+    "model_fwd_flops",
+    "percentiles_ms",
+    "resnet_fwd_flops",
+    "train_step_flops",
+    "vit_fwd_flops",
+]

@@ -1,0 +1,165 @@
+"""RunTelemetry — the one object the driver talks to (ISSUE 2 tentpole).
+
+Owns the registry/sink, the phase timer, the MFU estimator, the device
+monitor, the pod aggregator, and the heartbeat, and registers itself as a
+`log_event` sink so every resilience incident (preempt / rollback / chaos
+/ watchdog / sentinel) lands in the same events.jsonl stream it writes
+step records to.
+
+Process topology: EVERY process builds a RunTelemetry (the pod allgather
+needs all hosts' vectors), but only process 0 gets a file sink and a
+heartbeat — non-main registries aggregate instruments and drop record
+buffers, so the call sites stay identical on every host.
+
+Overhead contract (acceptance criterion): with telemetry off the driver
+holds no RunTelemetry and none of these paths run; with it on, the only
+synchronizing call is the stride-gated fence inside StepPhaseTimer —
+everything else is host-side arithmetic and buffered writes.
+"""
+
+from __future__ import annotations
+
+import os
+
+from moco_tpu.telemetry.device import DeviceMonitor
+from moco_tpu.telemetry.mfu import MFUEstimator
+from moco_tpu.telemetry.pod import PodAggregator
+from moco_tpu.telemetry.registry import (
+    EVENTS_FILENAME,
+    HEARTBEAT_FILENAME,
+    Heartbeat,
+    MetricsRegistry,
+)
+from moco_tpu.telemetry.timing import StepPhaseTimer
+from moco_tpu.utils import logging as mlog
+
+
+class RunTelemetry:
+    def __init__(self, config, *, n_chips: int, n_procs: int,
+                 process_index: int, steps_per_epoch: int, device=None):
+        import jax
+
+        if device is None:
+            device = jax.local_devices()[0]
+        is_main = process_index == 0
+        run_dir = config.telemetry_dir
+        self.events_path = os.path.join(run_dir, EVENTS_FILENAME)
+        self.registry = MetricsRegistry(
+            self.events_path if is_main else None,
+            flush_every=config.telemetry_flush_steps,
+        )
+        self.heartbeat = (
+            Heartbeat(os.path.join(run_dir, HEARTBEAT_FILENAME))
+            if is_main else None
+        )
+        self.timer = StepPhaseTimer(stride=config.telemetry_stride)
+        self.mfu = MFUEstimator.for_config(
+            config, n_chips, getattr(device, "device_kind", "")
+        )
+        self.devices = DeviceMonitor(device)
+        self.pod = PodAggregator(self.registry, n_procs, process_index)
+        self.n_chips = n_chips
+
+        self._step_hist = self.registry.histogram("step_s")
+        self._mfu_hist = self.registry.histogram("mfu")
+        self._hbm_gauge = self.registry.gauge("hbm_peak_bytes")
+        self._incidents = self.registry.counter("incidents")
+        self._closed = False
+        mlog.add_event_sink(self._on_event)
+        self.registry.emit(
+            "run_start",
+            name=config.name,
+            variant=config.variant,
+            arch=config.arch,
+            image_size=config.image_size,
+            batch_size=config.batch_size,
+            steps_per_epoch=steps_per_epoch,
+            n_chips=n_chips,
+            n_procs=n_procs,
+            device_kind=getattr(device, "device_kind", ""),
+            peak_flops_per_chip=self.mfu.peak_flops_per_chip,
+            flops_per_step=self.mfu.flops_per_step,
+            flops_per_image=self.mfu.flops_per_step / max(config.batch_size, 1),
+            telemetry_stride=config.telemetry_stride,
+        )
+        if self.heartbeat is not None:
+            self.heartbeat.beat(0, phase="run_start")
+
+    # -- incidents (log_event sink) -----------------------------------------
+    def _on_event(self, kind: str, msg: str, fields: dict) -> None:
+        self._incidents.inc()
+        self.registry.emit("event", event=kind, msg=msg, **fields)
+
+    def event(self, kind: str, **fields) -> None:
+        """Structured non-incident event (e.g. knn_eval, epoch_summary)."""
+        self.registry.emit("event", event=kind, **fields)
+
+    # -- per-step ------------------------------------------------------------
+    def on_step(self, step: int, phases: dict, throughput, loss=None) -> bool:
+        """Emit one step record; returns True when this step flushed the
+        sink (the driver aligns ScalarWriter.flush with that cadence)."""
+        record = dict(step=int(step))
+        for key, value in phases.items():
+            record[key] = round(value, 6)
+        rolling = throughput.rolling_imgs_per_sec
+        record["imgs_per_sec"] = round(rolling, 2)
+        record["imgs_per_sec_cum"] = round(throughput.imgs_per_sec, 2)
+        self._step_hist.observe(phases["step_s"])
+        mfu = self.mfu.mfu(phases["step_s"])
+        if mfu is not None:
+            record["mfu"] = round(mfu, 5)
+            self._mfu_hist.observe(mfu)
+        if loss is not None:
+            record["loss"] = float(loss)
+        stride = self.timer.stride or self.registry.flush_every
+        if step % stride == 0:
+            sampled = self.devices.sample()
+            record.update(sampled)
+            if "hbm_peak_bytes" in sampled:
+                self._hbm_gauge.set(sampled["hbm_peak_bytes"])
+            self.pod.update(**sampled)
+        self.pod.update(
+            step_s=phases["step_s"], data_s=phases["data_s"],
+            imgs_per_sec=rolling, incidents=self._incidents.value,
+        )
+        flushed = self.registry.emit("step", **record)
+        if flushed and self.heartbeat is not None:
+            self.heartbeat.beat(step)
+        return flushed
+
+    # -- pod sync (piggybacks on the resilience_sync_steps allgather) --------
+    def pod_vector(self):
+        return self.pod.local_vector()
+
+    def pod_record(self, step: int, gathered) -> None:
+        self.pod.record(step, gathered)
+
+    # -- teardown ------------------------------------------------------------
+    def close(self, **extra_summary) -> None:
+        """Idempotent: the driver closes with the run summary in its normal
+        finally; a bare safety-net close after an early abort no-ops if the
+        rich close already ran."""
+        if self._closed:
+            return
+        self._closed = True
+        mlog.remove_event_sink(self._on_event)
+        summary = dict(
+            steps=self._step_hist.count,
+            incidents=self._incidents.value,
+        )
+        if self._step_hist.count:
+            summary.update(
+                step_s_p50=round(self._step_hist.percentile(50), 6),
+                step_s_p95=round(self._step_hist.percentile(95), 6),
+                step_s_p99=round(self._step_hist.percentile(99), 6),
+            )
+        if self._mfu_hist.count:
+            summary["mfu_mean"] = round(self._mfu_hist.mean, 5)
+        if self._hbm_gauge.high_water > float("-inf"):
+            summary["hbm_peak_bytes"] = int(self._hbm_gauge.high_water)
+        summary.update(extra_summary)
+        self.registry.emit("run_end", **summary)
+        if self.heartbeat is not None:
+            self.heartbeat.beat(summary.get("last_step", self._step_hist.count),
+                                phase="run_end")
+        self.registry.close()

@@ -1,0 +1,100 @@
+"""Step-phase wall-clock splitting (ISSUE 2 tentpole part 2).
+
+A training step's wall time decomposes into:
+
+  data_s    — loader wait: the host blocked on the next batch (prefetch
+              misses, decode stalls, filesystem hiccups)
+  host_s    — dispatch: staging arrays + tracing-cache lookup + enqueue of
+              the jitted program; in a healthy async pipeline this is the
+              ONLY host cost per step
+  device_s  — device-compute drain, measured ONLY on fenced samples: every
+              `stride` steps the timer calls `block_until_ready` on a step
+              output and times dispatch-return → ready. This measures the
+              device backlog (the step itself plus anything still queued),
+              which is the honest number for "is the device the
+              bottleneck" — and the fence is what a comm/compute-overlap
+              PR will move, so it must stay OFF the steady-state path
+              (stride=0 never fences; off-stride steps stay fully async).
+  step_s    — the whole iteration (data_s + host_s + meters + everything);
+              on fenced steps it includes the fence wait.
+
+Usage per iteration (driver order):
+    timer.epoch_start()                  # aligns the first data window
+    ... loader yields ...
+    timer.mark_data()
+    ... fused_step dispatch returns ...
+    timer.mark_dispatch()
+    timer.maybe_fence(step, sync_obj)    # stride-gated block_until_ready
+    phases = timer.finish_step()         # {"data_s", "host_s", ...}
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class StepPhaseTimer:
+    def __init__(self, stride: int = 0):
+        self.stride = max(int(stride), 0)
+        self.fences = 0  # how many steps actually paid a fence (tests pin
+                         # that this NEVER exceeds steps/stride)
+        self._t_iter = None
+        self._t_data = None
+        self._t_dispatch = None
+        self._device_s = None
+
+    def epoch_start(self) -> None:
+        now = time.perf_counter()
+        self._t_iter = now
+        self._t_data = self._t_dispatch = None
+        self._device_s = None
+
+    def mark_data(self) -> None:
+        self._t_data = time.perf_counter()
+
+    def mark_dispatch(self) -> None:
+        self._t_dispatch = time.perf_counter()
+
+    def maybe_fence(self, step: int, sync_obj) -> float | None:
+        """Stride-gated device fence; returns device_s on sampled steps.
+
+        `sync_obj` is any step output (the loss array); draining it fences
+        this step's program and everything queued before it. The sync is a
+        real device→host TRANSFER (`float`) when the object is scalar:
+        `block_until_ready` does not reliably synchronize on the
+        experimental axon PJRT relay (moco_tpu/utils/benchkit.py) — a
+        fence that returns early would record a near-zero device phase and
+        tell the exact lie this telemetry exists to prevent.
+        `block_until_ready` remains the fallback for non-scalar outputs."""
+        if self.stride <= 0 or step % self.stride != 0:
+            return None
+        if self._t_dispatch is None:  # fence without a dispatch mark
+            return None
+        try:
+            float(sync_obj)
+        except (TypeError, ValueError):
+            import jax
+
+            jax.block_until_ready(sync_obj)
+        self._device_s = time.perf_counter() - self._t_dispatch
+        self.fences += 1
+        return self._device_s
+
+    def finish_step(self) -> dict:
+        """Close the iteration; returns the phase dict and re-arms for the
+        next step (the next data window starts now)."""
+        now = time.perf_counter()
+        t0 = self._t_iter if self._t_iter is not None else now
+        t_data = self._t_data if self._t_data is not None else t0
+        t_disp = self._t_dispatch if self._t_dispatch is not None else t_data
+        phases = {
+            "step_s": now - t0,
+            "data_s": t_data - t0,
+            "host_s": t_disp - t_data,
+        }
+        if self._device_s is not None:
+            phases["device_s"] = self._device_s
+        self._t_iter = now
+        self._t_data = self._t_dispatch = None
+        self._device_s = None
+        return phases

@@ -53,7 +53,7 @@ from moco_tpu.resilience import (
 )
 from moco_tpu.train_state import create_train_state
 from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
-from moco_tpu.utils.logging import ProfilerWindow, ScalarWriter, log_event
+from moco_tpu.utils.logging import ProfilerWindow, ScalarWriter, info, log_event
 from moco_tpu.utils.meters import AverageMeter, ProgressMeter, RateMeter, Throughput
 
 
@@ -153,11 +153,10 @@ def _monitor_val_split(config, train_dataset):
             except FileNotFoundError:
                 return None  # empty val/ placeholder: no class subdirs
             if val.class_to_idx != getattr(train_dataset, "class_to_idx", None):
-                print(
+                info(
                     "kNN monitor: val/ class directories differ from train/ "
                     "— labels would misalign; falling back to a train "
-                    "hold-out split",
-                    flush=True,
+                    "hold-out split"
                 )
                 return None
             return val
@@ -268,6 +267,25 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
 def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
                 dataset=None, data_advance: int = 0,
                 poison_pos: tuple[int, int] | None = None):
+    """Safety shell around `_train_once_impl`: telemetry is created early in
+    the pass (so rollback/resume incidents are captured) but the step loop's
+    own finally is far below — an exception in between (corrupt restore,
+    baseline-eval failure) must still unregister the log_event sink and
+    close the events file. `close()` is idempotent, so the impl's rich
+    summary close wins when both run."""
+    open_telemetry: list = []
+    try:
+        return _train_once_impl(config, mesh, max_steps, dataset,
+                                data_advance, poison_pos, open_telemetry)
+    finally:
+        for tel in open_telemetry:
+            tel.close()
+
+
+def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
+                     dataset=None, data_advance: int = 0,
+                     poison_pos: tuple[int, int] | None = None,
+                     _telemetry_out: list | None = None):
     """One driver pass (the body `train` retries around on rollback).
     `data_advance`: skip the data stream forward past the poisoned window —
     weights restart from the restored checkpoint but the window is never
@@ -296,12 +314,32 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
     available = max(len(dataset) // config.batch_size, 1)
     steps_per_epoch = min(config.steps_per_epoch or available, available)
     if config.steps_per_epoch and steps_per_epoch < config.steps_per_epoch:
-        print(
+        info(
             f"steps_per_epoch clamped {config.steps_per_epoch} -> "
             f"{steps_per_epoch}: the {len(dataset)}-sample dataset yields only "
-            f"{available} batches of {config.batch_size}",
-            flush=True,
+            f"{available} batches of {config.batch_size}"
         )
+
+    # observability on process 0 only: every host writing the same tags into
+    # one tb_dir duplicates curves, and concurrent profiler traces race
+    is_main = jax.process_index() == 0
+    n_procs = jax.process_count()
+    # structured telemetry (ISSUE 2): EVERY process builds one (the pod
+    # allgather needs all hosts' vectors) but only process 0 writes
+    # events.jsonl + heartbeat. None when off — the step loop then runs
+    # zero telemetry code (no fences, no sampling: the overhead contract).
+    # Created BEFORE the rollback/data-advance events below so every
+    # incident of this driver pass lands in the stream.
+    telemetry = None
+    if config.telemetry_dir:
+        from moco_tpu.telemetry import RunTelemetry
+
+        telemetry = RunTelemetry(
+            config, n_chips=n_chips, n_procs=n_procs,
+            process_index=jax.process_index(), steps_per_epoch=steps_per_epoch,
+        )
+        if _telemetry_out is not None:
+            _telemetry_out.append(telemetry)
 
     model = build_encoder(config)
     tx, sched = build_optimizer(config, steps_per_epoch)
@@ -396,10 +434,6 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
     baseline_metrics: dict = {}
     feature_fn = make_feature_fn(model, config.variant) if config.knn_monitor else None
     monitor_val = _monitor_val_split(config, dataset) if config.knn_monitor else None
-    # observability on process 0 only: every host writing the same tags into
-    # one tb_dir duplicates curves, and concurrent profiler traces race
-    is_main = jax.process_index() == 0
-    n_procs = jax.process_count()
     writer = ScalarWriter(config.tb_dir if is_main else "")
     profiler = ProfilerWindow(
         config.profile_dir if is_main else "", config.profile_start, config.profile_stop
@@ -424,26 +458,27 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
         # interval, which would silently drop the baseline row
         baseline_metrics[tag0] = acc0
         if is_main:
-            print(
+            info(
                 f"Epoch [-1] kNN({'val' if is_val0 else 'train'}) top-1 "
                 f"{100 * acc0:.2f}% (UNTRAINED baseline; chance "
-                f"{100.0 / dataset.num_classes:.2f}%)",
-                flush=True,
+                f"{100.0 / dataset.num_classes:.2f}%)"
             )
             writer.write(0, {tag0: acc0})
-            if baseline_sidecar:
-                # persist next to the checkpoints: a resumed run can no
-                # longer MEASURE the untrained baseline (the restored
-                # encoder is trained), so it must inherit the recorded
-                # one — otherwise resume silently weakens any gate that
-                # compares against it
-                # atomic: a preemption mid-write must not leave truncated
-                # JSON that bricks every later resume (the whole point of
-                # the sidecar is surviving preemption)
-                tmp = baseline_sidecar + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump({tag0: float(acc0)}, f)
-                os.replace(tmp, baseline_sidecar)
+        if telemetry is not None:
+            telemetry.event("knn_eval", step=0, tag=tag0, acc=float(acc0))
+        if is_main and baseline_sidecar:
+            # persist next to the checkpoints: a resumed run can no
+            # longer MEASURE the untrained baseline (the restored
+            # encoder is trained), so it must inherit the recorded
+            # one — otherwise resume silently weakens any gate that
+            # compares against it
+            # atomic: a preemption mid-write must not leave truncated
+            # JSON that bricks every later resume (the whole point of
+            # the sidecar is surviving preemption)
+            tmp = baseline_sidecar + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({tag0: float(acc0)}, f)
+            os.replace(tmp, baseline_sidecar)
     elif config.knn_monitor and global_step > 0 and baseline_sidecar and \
             os.path.exists(baseline_sidecar):
         try:
@@ -459,10 +494,9 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
         baseline_metrics.update(restored)
         if is_main and restored:
             tag0, acc0 = next(iter(restored.items()))
-            print(
+            info(
                 f"Epoch [-1] kNN top-1 {100 * acc0:.2f}% (UNTRAINED "
-                f"baseline, restored from {baseline_sidecar})",
-                flush=True,
+                f"baseline, restored from {baseline_sidecar})"
             )
 
     # resilience hooks (ISSUE 1): signal-flag preemption, every-step NaN
@@ -488,7 +522,10 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
                 [batch_time, data_time, losses, top1, top5, decode_fail],
                 prefix=f"Epoch: [{epoch}]",
             )
-            throughput = Throughput(n_chips)
+            # rolling window for the per-step line: the cumulative view is
+            # polluted by the first-step compile stall for the whole epoch
+            # (ISSUE 2 satellite); epoch summary still reports cumulative
+            throughput = Throughput(n_chips, window=32)
             skip = resume_skip if epoch == start_epoch else 0
             if poison_epoch is not None and epoch <= poison_epoch:
                 # inside the poisoned window: epochs before the poison's are
@@ -503,14 +540,23 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
                 backoff_secs=config.loader_backoff_secs,
             )
             end = time.perf_counter()
+            if telemetry is not None:
+                telemetry.timer.epoch_start()
             try:
                 for i, (imgs, _labels, extents) in enumerate(loader, start=skip):
                     if i >= steps_per_epoch:  # steps_per_epoch may cap the epoch
                         break
                     data_time.update(time.perf_counter() - end)
+                    if telemetry is not None:
+                        telemetry.timer.mark_data()
                     profiler.maybe_toggle(global_step)
                     state, metrics = fused_step(state, imgs, extents, global_step)
                     global_step += 1
+                    if telemetry is not None:
+                        telemetry.timer.mark_dispatch()
+                        # stride-gated device fence: off-stride steps stay
+                        # fully async (the overhead contract)
+                        telemetry.timer.maybe_fence(global_step, metrics["loss"])
                     if plan is not None and plan.maybe_nan(global_step):
                         # emulate a real divergence end-to-end: the NaN flows
                         # through the same metrics dict the sentinel/meters see
@@ -543,6 +589,17 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
                             preempt_agreed = bool(agg[:, 0].max())
                             abort_fail = int(agg[:, 1].sum())
                             abort_total = int(agg[:, 2].sum())
+                            if telemetry is not None:
+                                # pod telemetry piggybacks on this already-
+                                # synchronizing cadence: one extra small
+                                # allgather, no new sync points; process 0
+                                # folds the matrix into a `pod` record
+                                telemetry.pod_record(
+                                    global_step,
+                                    multihost_utils.process_allgather(
+                                        telemetry.pod_vector()
+                                    ),
+                                )
                     if (
                         config.decode_abort_rate
                         and abort_total >= config.batch_size
@@ -555,9 +612,11 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
                             "training on zero canvases would silently waste "
                             "the run"
                         )
+                    step_loss = None  # host-synced loss, when printing pulls it
                     if i % config.print_freq == 0:
                         # pull metrics (host sync) only when printing
                         last_metrics = {k: float(v) for k, v in metrics.items()}
+                        step_loss = last_metrics["loss"]
                         if config.debug_nans and not np.isfinite(last_metrics["loss"]):
                             raise FloatingPointError(
                                 f"non-finite loss {last_metrics['loss']} at step {global_step}"
@@ -571,8 +630,15 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
                             global_step,
                             dict(
                                 last_metrics,
-                                imgs_per_sec=throughput.imgs_per_sec,
-                                imgs_per_sec_per_chip=throughput.imgs_per_sec_per_chip,
+                                # per-step line reports the ROLLING rate (the
+                                # cumulative one drags the compile stall
+                                # through the whole epoch); the epoch summary
+                                # below stays cumulative
+                                imgs_per_sec=throughput.rolling_imgs_per_sec,
+                                imgs_per_sec_per_chip=(
+                                    throughput.rolling_imgs_per_sec
+                                    / max(n_chips, 1)
+                                ),
                                 decode_failures=d_fail,
                                 decode_failure_rate=decode_fail.rate,
                             ),
@@ -580,6 +646,13 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
                     throughput.update(config.batch_size)
                     batch_time.update(time.perf_counter() - end)
                     end = time.perf_counter()
+                    if telemetry is not None:
+                        phases = telemetry.timer.finish_step()
+                        if telemetry.on_step(global_step, phases, throughput,
+                                             loss=step_loss):
+                            # flushed: land the TensorBoard curves at the
+                            # same cadence (ISSUE 2 satellite)
+                            writer.flush()
                     if plan is not None:
                         plan.maybe_sigterm(global_step)
                     if preempt_agreed or (n_procs == 1 and preempt.triggered):
@@ -607,11 +680,19 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
                 sentinel.flush()
             if preempted:
                 break  # no epoch eval/save: the emergency checkpoint follows
-            print(
+            # epoch summary stays CUMULATIVE (honest average incl. the
+            # compile stall); the per-step line above reports rolling
+            info(
                 f"Epoch [{epoch}] imgs/sec {throughput.imgs_per_sec:.1f} "
-                f"({throughput.imgs_per_sec_per_chip:.1f}/chip)",
-                flush=True,
+                f"({throughput.imgs_per_sec_per_chip:.1f}/chip)"
             )
+            if telemetry is not None:
+                telemetry.event(
+                    "epoch_summary", epoch=epoch, step=global_step,
+                    imgs_per_sec=round(throughput.imgs_per_sec, 2),
+                    imgs_per_sec_rolling=round(
+                        throughput.rolling_imgs_per_sec, 2),
+                )
             # cadence: every knn_every_epochs, plus the run's final epoch
             # (early `done` break included) so end-of-run gates always see a
             # current number. Zero-step epochs (a rollback skipped them
@@ -636,9 +717,11 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
                 tag = "knn_val_top1" if is_val else "knn_train_top1"
                 label = "val" if is_val else "train"
                 last_metrics[tag] = acc
-                print(f"Epoch [{epoch}] kNN({label}) top-1 {100 * acc:.2f}%",
-                      flush=True)
+                info(f"Epoch [{epoch}] kNN({label}) top-1 {100 * acc:.2f}%")
                 writer.write(global_step, {tag: acc})
+                if telemetry is not None:
+                    telemetry.event("knn_eval", step=global_step, epoch=epoch,
+                                    tag=tag, acc=float(acc))
             if (
                 mgr is not None
                 and global_step > epoch_start_step  # an epoch the rollback
@@ -662,6 +745,11 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
         # restore signal dispositions and stop the watchdog thread
         _resilience.close()
         profiler.close()
+        if telemetry is not None:
+            # run_end summary + final flush; also surfaces the writer's
+            # dropped-scalar count (ISSUE 2 satellite) so silent drops are
+            # visible in the machine record
+            telemetry.close(scalar_drops=writer.dropped, last_step=global_step)
         writer.close()
         if mgr is not None:
             # commit any in-flight async epoch save (and its deferred
@@ -699,7 +787,7 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
             from moco_tpu.checkpoint import export_encoder_q
 
             export_encoder_q(state, config.export_path)
-        print(f"exported encoder -> {config.export_path}", flush=True)
+        info(f"exported encoder -> {config.export_path}")
     return state, {**baseline_metrics, **last_metrics}
 
 
@@ -740,8 +828,8 @@ def main(argv=None):
 
     enable_persistent_cache()
     mesh = create_mesh(args.num_devices)
-    print(f"config: {config}")
-    print(f"mesh: {mesh}")
+    info(f"config: {config}")
+    info(f"mesh: {mesh}")
     train(config, mesh, max_steps=args.max_steps)
 
 

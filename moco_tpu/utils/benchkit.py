@@ -124,3 +124,27 @@ def time_fused_step(fused, state, imgs_u8, extents, *, warmup: int,
     # a fast-but-wrong kernel must not publish a number
     assert np.isfinite(loss), f"non-finite benchmark loss {loss}"
     return best, compile_warmup_s, loss, state
+
+
+def time_step_percentiles(fused, state, imgs_u8, extents, *, steps: int,
+                          step_base: int = 10_000):
+    """Per-step wall-time distribution: `steps` steps, EACH synced to the
+    host via `float(loss)` (ISSUE 2: the tail — p95/p99 — is what a perf
+    PR must not regress, and chained timing can only see the mean).
+
+    The per-step sync adds one device→host round-trip to every sample
+    (~70 ms on the tunneled relay, negligible on local backends), so these
+    percentiles are comparable to EACH OTHER and to other synced runs —
+    not to the chained `time_fused_step` mean. Returns
+    `({"p50": ms, "p95": ms, "p99": ms}, state)`.
+    """
+    from moco_tpu.telemetry import percentiles_ms
+
+    times = []
+    for i in range(steps):
+        t0 = time.perf_counter()
+        state, metrics = fused(state, imgs_u8, extents, step_base + i)
+        loss = float(metrics["loss"])  # the only reliable sync on the relay
+        times.append(time.perf_counter() - t0)
+    assert np.isfinite(loss), f"non-finite percentile-pass loss {loss}"
+    return percentiles_ms(times), state

@@ -2,33 +2,74 @@
 
 The reference has stdout meters only; the bl0 fork adds optional TensorBoard
 scalars. Here: a thin tensorboardX writer (no-op when disabled or when the
-package is missing) and a `jax.profiler` trace window — the traces open in
-TensorBoard's profile plugin for MXU/HBM analysis."""
+package is missing), a `jax.profiler` trace window — the traces open in
+TensorBoard's profile plugin for MXU/HBM analysis — and the structured
+channels (ISSUE 2): `log_event` fans incidents out to registered sinks
+(telemetry/ lands them in events.jsonl), and `info` is the ONE sanctioned
+plain-line print, so tools/lint_robustness.py can forbid bare `print` in
+the package and every event stays machine-consumable.
+"""
 
 from __future__ import annotations
 
+# structured-event sinks (ISSUE 2): telemetry registers a callable
+# `(kind, msg, fields) -> None` so resilience incidents land in the JSONL
+# stream; the stdout line below stays — grepability in raw logs is a
+# feature, not a fallback
+_EVENT_SINKS: list = []
 
-def log_event(kind: str, msg: str) -> None:
+
+def add_event_sink(sink) -> None:
+    if sink not in _EVENT_SINKS:
+        _EVENT_SINKS.append(sink)
+
+
+def remove_event_sink(sink) -> None:
+    if sink in _EVENT_SINKS:
+        _EVENT_SINKS.remove(sink)
+
+
+def log_event(kind: str, msg: str, **fields) -> None:
     """One-line structured event log (`[kind] msg`, flushed) — the channel
     the resilience subsystem reports through. A fixed `[kind]` prefix keeps
     preemption/rollback/chaos events greppable in multi-day run logs, where
-    they would otherwise drown in the per-step meter lines."""
+    they would otherwise drown in the per-step meter lines. Extra `fields`
+    ride only the structured sinks (telemetry events.jsonl), not the line."""
     print(f"[{kind}] {msg}", flush=True)
+    for sink in list(_EVENT_SINKS):
+        try:
+            sink(kind, msg, fields)
+        except Exception as e:  # a broken sink must not take down the run
+            print(f"[telemetry] event sink failed: {e!r}", flush=True)
+
+
+def info(msg: str) -> None:
+    """Plain human-facing line (flushed). The package's only sanctioned
+    free-text print outside the meters: everything event-shaped must use
+    `log_event` so it reaches the structured sinks."""
+    print(msg, flush=True)
 
 
 class ScalarWriter:
     """tensorboardX SummaryWriter wrapper; silently no-ops when `logdir` is
-    empty or tensorboardX is unavailable."""
+    empty or tensorboardX is unavailable (the unavailability warning prints
+    on process 0 only — every host of a pod repeating it is noise).
+
+    Unconvertible scalars are counted (`dropped`) and surfaced once per run
+    through `log_event` instead of vanishing in a bare `continue`."""
 
     def __init__(self, logdir: str = ""):
         self._writer = None
+        self.dropped = 0
+        self._drop_warned = False
         if logdir:
             try:
                 from tensorboardX import SummaryWriter
 
                 self._writer = SummaryWriter(logdir)
             except ImportError:
-                print(f"tensorboardX unavailable; not writing scalars to {logdir}")
+                if _is_main_process():
+                    info(f"tensorboardX unavailable; not writing scalars to {logdir}")
 
     def write(self, step: int, scalars: dict) -> None:
         if self._writer is None:
@@ -37,11 +78,37 @@ class ScalarWriter:
             try:
                 self._writer.add_scalar(name, float(value), step)
             except (TypeError, ValueError):
-                continue
+                self.dropped += 1
+                if not self._drop_warned:
+                    self._drop_warned = True
+                    log_event(
+                        "scalar_writer",
+                        f"dropped unconvertible scalar {name!r} "
+                        f"({type(value).__name__}) at step {step}; further "
+                        "drops are counted, see the run_end summary",
+                        name=name, step=step,
+                    )
+
+    def flush(self) -> None:
+        """Explicit flush, called alongside the telemetry flush cadence so
+        TensorBoard curves and events.jsonl stay equally fresh mid-run."""
+        if self._writer is not None:
+            self._writer.flush()
 
     def close(self) -> None:
         if self._writer is not None:
             self._writer.close()
+
+
+def _is_main_process() -> bool:
+    """process_index 0, defaulting to True when jax has no backend yet (the
+    writer must stay constructible before/without device init)."""
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except (ImportError, RuntimeError):
+        return True
 
 
 class ProfilerWindow:

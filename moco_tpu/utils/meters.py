@@ -5,6 +5,7 @@ throughput metric (BASELINE.md derived-throughput row)."""
 from __future__ import annotations
 
 import time
+from collections import deque
 
 
 class AverageMeter:
@@ -69,23 +70,49 @@ class RateMeter:
 
 
 class Throughput:
-    """imgs/sec (global and per-chip) over a rolling window."""
+    """imgs/sec, cumulative AND over a rolling window of recent updates.
 
-    def __init__(self, num_chips: int):
+    Cumulative (`imgs_per_sec`) is the honest epoch summary but is polluted
+    for the whole epoch by the first-step compile stall; the rolling window
+    (`rolling_imgs_per_sec`, last `window` updates) converges to the steady
+    state within `window` steps, so the PER-STEP meter line reports it
+    (ISSUE 2 satellite). `window=0` disables the rolling view (it then
+    falls back to cumulative)."""
+
+    def __init__(self, num_chips: int, window: int = 0):
         self.num_chips = num_chips
+        self.window = max(int(window), 0)
         self.reset()
 
     def reset(self):
         self._t0 = time.perf_counter()
         self._images = 0
+        # (timestamp, images-since-previous-entry); the reset sentinel
+        # anchors the first interval, then slides out with the stall
+        self._recent: deque | None = (
+            deque([(self._t0, 0)], maxlen=self.window + 1) if self.window else None
+        )
 
     def update(self, n_images: int):
         self._images += n_images
+        if self._recent is not None:
+            self._recent.append((time.perf_counter(), n_images))
 
     @property
     def imgs_per_sec(self) -> float:
         dt = time.perf_counter() - self._t0
         return self._images / dt if dt > 0 else 0.0
+
+    @property
+    def rolling_imgs_per_sec(self) -> float:
+        """Rate over the last `window` updates (cumulative when disabled or
+        before two entries exist). Entry 0 only anchors time: its images
+        arrived before the window opened."""
+        if self._recent is None or len(self._recent) < 2:
+            return self.imgs_per_sec
+        dt = self._recent[-1][0] - self._recent[0][0]
+        images = sum(n for _, n in list(self._recent)[1:])
+        return images / dt if dt > 0 else 0.0
 
     @property
     def imgs_per_sec_per_chip(self) -> float:

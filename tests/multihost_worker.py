@@ -53,6 +53,13 @@ def make_config(mode: str, ckpt_dir: str):
         arch="resnet_tiny", cifar_stem=True, embed_dim=16, batch_size=16,
         image_size=8, epochs=2, steps_per_epoch=3, seed=0, ckpt_dir=ckpt_dir,
         ckpt_every_epochs=2, num_workers=1,
+        # pod telemetry across the REAL process boundary (ISSUE 2): the
+        # allgather piggybacks on resilience_sync_steps, so the cadence
+        # must divide the 6-step run; proc 0 writes events.jsonl with
+        # `pod` records the parent test parses
+        telemetry_dir=ckpt_dir + "_telemetry",
+        telemetry_flush_steps=4, telemetry_stride=2,
+        resilience_sync_steps=2, peak_flops_per_chip=1e12,
     )
     if mode == "v2":
         return PretrainConfig(

@@ -41,8 +41,30 @@ def test_detects_broad_silent_swallow(tmp_path):
     (tmp_path / "swallow.py").write_text(
         "try:\n    x = 1\nexcept Exception:\n    pass\n"
         "try:\n    y = 2\nexcept (ValueError, OSError):\n    pass\n"  # legal
-        "try:\n    z = 3\nexcept Exception as e:\n    print(e)\n"     # legal
+        "try:\n    z = 3\nexcept Exception as e:\n    log(e)\n"       # legal
     )
     found = lint.check_file(str(tmp_path / "swallow.py"))
     assert len(found) == 1
     assert ":3:" in found[0] and "silently swallows" in found[0]
+
+
+def test_detects_bare_print_outside_logging(tmp_path):
+    """R3 (ISSUE 2): bare print() bypasses the structured channel."""
+    (tmp_path / "chatty.py").write_text(
+        "print('hello')\n"
+        "info('fine: the sanctioned channel')\n"
+        "x.print('fine: a method, not the builtin')\n"
+    )
+    found = lint.check_file(str(tmp_path / "chatty.py"))
+    assert len(found) == 1
+    assert ":1:" in found[0] and "bare `print(" in found[0]
+
+
+def test_print_allowed_in_logging_and_meters(tmp_path):
+    """The channels themselves (log_event/info, console meters) must stay
+    allowed — they ARE the sanctioned print sites."""
+    for allowed in ("utils/logging.py", "utils/meters.py"):
+        path = tmp_path / "pkg" / allowed
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text("print('the channel itself')\n")
+        assert lint.check_file(str(path)) == []

@@ -58,12 +58,29 @@ def _run_pair(ckpt_dir: str, mode: str, phase: str) -> dict[int, tuple]:
 def test_two_process_v2_train_restore_bitfaithful(tmp_path):
     """v2 (sharded aug + queue + ShuffleBN): replicas agree bit-for-bit after
     6 driver steps, and a FRESH 2-process session restores the checkpoint to
-    exactly the trained state."""
+    exactly the trained state. The train pair also exercises pod telemetry
+    (ISSUE 2): process 0 must write events.jsonl containing `pod` records
+    aggregated from BOTH hosts at the resilience_sync_steps cadence."""
+    import json
+
     ckpt_dir = str(tmp_path / "ckpt_v2")
     trained = _run_pair(ckpt_dir, "v2", "train")
     assert trained[0] == trained[1], f"process state diverged: {trained}"
     assert trained[0][0] == "6"  # 2 epochs x 3 steps through the real driver
     assert os.path.isdir(os.path.join(ckpt_dir, "6"))
+
+    events_path = os.path.join(ckpt_dir + "_telemetry", "events.jsonl")
+    assert os.path.exists(events_path), "process 0 wrote no telemetry events"
+    with open(events_path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    pods = [r for r in records if r.get("kind") == "pod"]
+    assert pods, f"no pod records in {sorted({r.get('kind') for r in records})}"
+    assert all(p["hosts"] == 2 for p in pods), pods
+    assert all(p["step_s_max"] >= p["step_s_min"] >= 0.0 for p in pods)
+    steps = [r for r in records if r.get("kind") == "step"]
+    assert len(steps) == 6, f"expected 6 step records, got {len(steps)}"
+    assert os.path.exists(
+        os.path.join(ckpt_dir + "_telemetry", "heartbeat.json"))
 
     restored = _run_pair(ckpt_dir, "v2", "restore")
     assert restored[0] == restored[1], f"restore diverged: {restored}"

@@ -14,6 +14,11 @@ Rules, AST-enforced over every .py file under the package:
       Narrow named exceptions (`except (AttributeError, ValueError): pass`)
       stay legal: deliberately ignoring a specific, expected failure is a
       policy the type spells out.
+  R3  (ISSUE 2) no bare `print(...)` outside utils/logging.py and
+      utils/meters.py — an event printed anywhere else bypasses the
+      structured channel (`log_event` → telemetry events.jsonl) and the
+      one sanctioned plain-line path (`logging.info`), so an external
+      monitor can never consume it.
 
 Exit 0 when clean; exit 1 with one `path:line: message` per violation.
 Runs in tier-1 via tests/test_lint_robustness.py.
@@ -26,6 +31,10 @@ import os
 import sys
 
 BROAD = {"Exception", "BaseException"}
+
+# the only files allowed to call print(): the structured/sanctioned
+# channels themselves (log_event/info) and the console meters
+PRINT_ALLOWED = ("utils/logging.py", "utils/meters.py")
 
 
 def _names(node: ast.expr | None):
@@ -59,7 +68,22 @@ def check_file(path: str) -> list[str]:
     except SyntaxError as e:
         return [f"{path}:{e.lineno}: unparseable ({e.msg})"]
     out = []
+    print_allowed = os.path.normpath(path).replace(os.sep, "/").endswith(
+        PRINT_ALLOWED
+    )
     for node in ast.walk(tree):
+        if (
+            not print_allowed
+            and isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            out.append(
+                f"{path}:{node.lineno}: bare `print(...)` — route through "
+                "utils.logging (log_event for events, info for plain lines) "
+                "so the structured telemetry sinks see it"
+            )
+            continue
         if not isinstance(node, ast.ExceptHandler):
             continue
         if node.type is None:

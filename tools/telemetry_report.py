@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Offline summarizer for telemetry events.jsonl (ISSUE 2 tentpole part 6).
+
+    python tools/telemetry_report.py <run_dir>/telemetry/events.jsonl
+    python tools/telemetry_report.py events.jsonl --json
+
+Renders, from the schema-versioned record stream the driver writes
+(moco_tpu/telemetry/registry.py):
+
+  - step-time p50/p95/p99 (ms) + the data/host/device phase split
+  - MFU (mean/max) and the peak-FLOPs assumption it was judged against
+  - throughput (rolling at end-of-run, cumulative mean)
+  - HBM high-water mark + host-RSS high-water
+  - incident counts by event kind (preempt/rollback/chaos/watchdog/...)
+  - pod-record count and worst cross-host step-time spread
+
+Robustness: unparseable lines (a torn tail from a SIGKILL mid-flush) are
+counted and skipped, never fatal; unknown record kinds and unknown future
+schema versions are tallied but not interpreted. `--json` emits one
+machine-readable summary object instead of the human text. Pure stdlib —
+runs anywhere the events file can be copied to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_events(path: str) -> tuple[list[dict], int]:
+    """Parse a JSONL events file; returns (records, skipped_line_count)."""
+    records, skipped = [], 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+            else:
+                skipped += 1
+    return records, skipped
+
+
+def _percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(0, min(len(ordered) - 1, round(q / 100.0 * (len(ordered) - 1))))
+    return ordered[int(rank)]
+
+
+def summarize(records: list[dict], skipped: int = 0) -> dict:
+    """Fold parsed records into one summary dict (the --json payload)."""
+    steps = [r for r in records if r.get("kind") == "step"]
+    events = [r for r in records if r.get("kind") == "event"]
+    pods = [r for r in records if r.get("kind") == "pod"]
+    run_starts = [r for r in records if r.get("kind") == "run_start"]
+    run_ends = [r for r in records if r.get("kind") == "run_end"]
+
+    step_s = [r["step_s"] for r in steps if "step_s" in r]
+    data_s = [r["data_s"] for r in steps if "data_s" in r]
+    host_s = [r["host_s"] for r in steps if "host_s" in r]
+    device_s = [r["device_s"] for r in steps if "device_s" in r]
+    mfu = [r["mfu"] for r in steps if "mfu" in r]
+    hbm = [r["hbm_peak_bytes"] for r in steps if "hbm_peak_bytes" in r]
+    rss = [r["host_rss_bytes"] for r in steps if "host_rss_bytes" in r]
+
+    events_by_kind: dict[str, int] = {}
+    for e in events:
+        key = str(e.get("event", "unknown"))
+        events_by_kind[key] = events_by_kind.get(key, 0) + 1
+    # incidents = events that signal trouble; routine markers the driver
+    # emits on purpose (epoch/eval bookkeeping) are reported separately,
+    # matching the driver's own `incidents` counter (log_event-routed only)
+    routine = {"epoch_summary", "knn_eval"}
+    incidents = {k: v for k, v in events_by_kind.items() if k not in routine}
+
+    summary: dict = {
+        "records": len(records),
+        "skipped_lines": skipped,
+        "runs": len(run_starts),
+        "steps": len(steps),
+        "events_by_kind": events_by_kind,
+        "incidents": incidents,
+        "incidents_total": sum(incidents.values()),
+        "pod_records": len(pods),
+    }
+    if run_starts:
+        first = run_starts[0]
+        summary["run"] = {
+            k: first[k]
+            for k in ("name", "variant", "arch", "batch_size", "n_chips",
+                      "n_procs", "device_kind", "peak_flops_per_chip",
+                      "flops_per_step")
+            if k in first
+        }
+    if step_s:
+        summary["step_time_ms"] = {
+            f"p{q}": round(_percentile(step_s, q) * 1e3, 3) for q in (50, 95, 99)
+        }
+        total = sum(step_s)
+        summary["phase_share"] = {
+            "data": round(sum(data_s) / total, 4) if total else 0.0,
+            "host": round(sum(host_s) / total, 4) if total else 0.0,
+        }
+        summary["steps_span"] = [steps[0].get("step"), steps[-1].get("step")]
+    if device_s:
+        summary["device_time_ms"] = {
+            "samples": len(device_s),
+            "p50": round(_percentile(device_s, 50) * 1e3, 3),
+            "max": round(max(device_s) * 1e3, 3),
+        }
+    if mfu:
+        summary["mfu"] = {
+            "mean": round(sum(mfu) / len(mfu), 5),
+            "max": round(max(mfu), 5),
+        }
+    throughputs = [r["imgs_per_sec"] for r in steps if "imgs_per_sec" in r]
+    if throughputs:
+        summary["imgs_per_sec"] = {
+            "last": round(throughputs[-1], 2),
+            "mean": round(sum(throughputs) / len(throughputs), 2),
+        }
+    if hbm:
+        summary["hbm_high_water_bytes"] = int(max(hbm))
+    if rss:
+        summary["host_rss_high_water_bytes"] = int(max(rss))
+    if pods:
+        spreads = [
+            p["step_s_max"] - p["step_s_min"]
+            for p in pods
+            if "step_s_max" in p and "step_s_min" in p
+        ]
+        if spreads:
+            summary["pod_step_spread_ms_max"] = round(max(spreads) * 1e3, 3)
+    if run_ends:
+        summary["run_end"] = run_ends[-1]
+    return summary
+
+
+def render(summary: dict) -> str:
+    """Human-readable report from a summarize() dict."""
+    lines = []
+    run = summary.get("run", {})
+    if run:
+        lines.append(
+            "run: {name} ({variant}/{arch}) batch={batch_size} "
+            "chips={n_chips} procs={n_procs}".format(
+                **{k: run.get(k, "?") for k in
+                   ("name", "variant", "arch", "batch_size", "n_chips",
+                    "n_procs")}
+            )
+        )
+        if run.get("peak_flops_per_chip"):
+            lines.append(
+                f"  MFU basis: {run['peak_flops_per_chip'] / 1e12:.0f} "
+                f"TFLOP/s/chip peak, {run.get('flops_per_step', 0) / 1e9:.2f} "
+                f"GFLOP/step analytic"
+            )
+    lines.append(
+        f"records: {summary['records']} ({summary['steps']} steps, "
+        f"{summary['runs']} run(s), {summary['pod_records']} pod, "
+        f"{summary['skipped_lines']} unparseable line(s) skipped)"
+    )
+    pct = summary.get("step_time_ms")
+    if pct:
+        lines.append(
+            f"step time: p50 {pct['p50']:.1f} ms · p95 {pct['p95']:.1f} ms "
+            f"· p99 {pct['p99']:.1f} ms"
+        )
+        share = summary.get("phase_share", {})
+        lines.append(
+            f"  phase share: data {100 * share.get('data', 0):.1f}% · "
+            f"host {100 * share.get('host', 0):.1f}% (rest: async device/meters)"
+        )
+    dev = summary.get("device_time_ms")
+    if dev:
+        lines.append(
+            f"device drain (fenced, {dev['samples']} samples): "
+            f"p50 {dev['p50']:.1f} ms · max {dev['max']:.1f} ms"
+        )
+    mfu = summary.get("mfu")
+    if mfu:
+        lines.append(f"MFU: mean {100 * mfu['mean']:.2f}% · max {100 * mfu['max']:.2f}%")
+    else:
+        lines.append(
+            "MFU: n/a (no peak-FLOPs basis for this device_kind — re-run "
+            "training with peak_flops_per_chip set in the config)"
+        )
+    thr = summary.get("imgs_per_sec")
+    if thr:
+        lines.append(
+            f"throughput: {thr['last']:.1f} imgs/s (rolling, end of run) · "
+            f"{thr['mean']:.1f} mean"
+        )
+    if "hbm_high_water_bytes" in summary:
+        lines.append(
+            f"HBM high-water: {summary['hbm_high_water_bytes'] / 2**30:.2f} GiB"
+        )
+    if "host_rss_high_water_bytes" in summary:
+        lines.append(
+            f"host RSS high-water: "
+            f"{summary['host_rss_high_water_bytes'] / 2**30:.2f} GiB"
+        )
+    if "pod_step_spread_ms_max" in summary:
+        lines.append(
+            f"pod: {summary['pod_records']} records, worst cross-host step "
+            f"spread {summary['pod_step_spread_ms_max']:.1f} ms"
+        )
+    inc = summary.get("incidents", {})
+    if inc:
+        detail = ", ".join(f"{k}×{v}" for k, v in sorted(inc.items()))
+        lines.append(f"incidents: {summary['incidents_total']} ({detail})")
+    else:
+        lines.append("incidents: none")
+    routine = {
+        k: v for k, v in summary.get("events_by_kind", {}).items()
+        if k not in inc
+    }
+    if routine:
+        detail = ", ".join(f"{k}×{v}" for k, v in sorted(routine.items()))
+        lines.append(f"routine events: {detail}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    parser.add_argument("events", help="path to telemetry events.jsonl")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one machine-readable summary object")
+    args = parser.parse_args(argv)
+    try:
+        records, skipped = load_events(args.events)
+    except OSError as e:
+        print(f"cannot read {args.events}: {e}", file=sys.stderr)
+        return 2
+    summary = summarize(records, skipped)
+    if args.json:
+        print(json.dumps(summary, default=float))
+    else:
+        print(render(summary))
+    return 0 if summary["steps"] or summary["records"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
