@@ -272,7 +272,8 @@ def _orchestrate_body(mode: str, orch: "_Orchestrator") -> None:
             e2e = orch.run("e2e", "e2e", orch.remaining() - 15.0, e2e_env)
             if e2e is not None:
                 orch.extras["e2e"] = {k: e2e[k] for k in
-                                      ("metric", "value", "unit", "vs_baseline")
+                                      ("metric", "value", "unit",
+                                       "vs_baseline", "input_pipeline")
                                       if k in e2e}
         else:
             orch.errors.append("e2e: skipped, step attempt consumed the budget")
@@ -329,8 +330,39 @@ def _make_jpeg_tree(root, n_images: int = 256, classes: int = 4, size=(500, 375)
     return paths
 
 
+def _staged_scaling_rows(root: str, detail: dict) -> None:
+    """ISSUE 3 acceptance rows: END-TO-END staging throughput (decode →
+    pooled canvas → device transfer) through the real `epoch_loader` at
+    1/2/4 staging workers, native pool sized to match. Best-of-3 per row:
+    these rows judge CAPACITY scaling, and the monotone 1→4 criterion must
+    not be decided by a scheduler hiccup in one rep (the first rep also
+    absorbs the one-time canvas page-fault, the r4 artifact)."""
+    from moco_tpu.data.datasets import ImageFolder
+    from moco_tpu.data.loader import epoch_loader
+    from moco_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(1)
+    bs = 64
+    for w in (1, 2, 4):
+        folder = ImageFolder(root, num_workers=w)
+        rates = []
+        for rep in range(3):
+            loader = epoch_loader(folder, epoch=rep, seed=0, global_batch=bs,
+                                  mesh=mesh, workers=w, depth=2)
+            try:
+                t0 = time.perf_counter()
+                n = 0
+                for _batch in loader:
+                    n += bs
+                rates.append(n / (time.perf_counter() - t0))
+            finally:
+                loader.close_quietly()
+        detail[f"staged_s512_w{w}"] = round(max(rates), 1)
+
+
 def bench_input():
-    """Host staging throughput: native loader by thread count + PIL."""
+    """Host staging throughput: native loader by thread count + PIL, plus
+    the ISSUE 3 `staged_s512_w{1,2,4}` end-to-end staging scaling rows."""
     import tempfile
 
     from moco_tpu.data.datasets import ImageFolder
@@ -379,6 +411,7 @@ def bench_input():
     folder.get_batch(sub)
     detail["pil_s512_1w"] = round(len(sub) / (time.perf_counter() - t0), 1)
     best = max(best, detail["pil_s512_1w"])
+    _staged_scaling_rows(root, detail)
     # the input-path question (SURVEY §7 hard-part 4): one 8-chip host must
     # stage ~8*step_rate imgs/s; report how many of THESE cores that takes
     per_core = detail.get("native_s512_1t", detail["pil_s512_1w"])
@@ -399,13 +432,19 @@ def bench_input():
 
 def bench_e2e():
     """Input-fed training: epoch_loader + ImageFolder (JPEG decode in the
-    loop) feeding the real MoCo-v2 step. The gap to the default (staged)
-    metric is exactly the un-overlapped host input cost on this host."""
+    loop) feeding the real MoCo-v2 step, through the ISSUE 3 pipeline:
+    parallel sharded staging, decode-once canvas cache, staging-side
+    (overlapped) H2D, and extent-trimmed transfers. The warm epoch fills
+    the cache and compiles; the timed epoch then measures the shipped
+    steady state — epochs >= 2 of a real run, where decode is a memcpy and
+    the transfer hides under the step. The gap to the default (staged)
+    metric is whatever input cost the overlap could NOT hide."""
     import tempfile
 
     import jax
 
     from moco_tpu.config import get_preset
+    from moco_tpu.data.canvas_cache import CachedDataset
     from moco_tpu.data.datasets import ImageFolder
     from moco_tpu.data.loader import epoch_loader
     from moco_tpu.parallel.mesh import create_mesh
@@ -417,7 +456,8 @@ def bench_e2e():
     mesh = create_mesh(n_chips)
     root = tempfile.mkdtemp(prefix="bench_e2e_")
     batch = (128 if on_tpu else 8) * n_chips
-    _make_jpeg_tree(root, n_images=batch * 4)
+    n_images = batch * 4
+    _make_jpeg_tree(root, n_images=n_images)
     # TPU: the shipping full-resolution default (512 canvas); CPU proxy
     # keeps the smaller canvas so the tiny-model proxy stays fast
     stage_size = 0 if on_tpu else 256
@@ -433,14 +473,23 @@ def bench_e2e():
             embed_dim=32,
         )
         steps = 3
-    dataset = ImageFolder(root, **({"stage_size": stage_size} if stage_size else {}))
+    workers = max(1, min(4, os.cpu_count() or 1))
+    depth = config.prefetch_depth
+    inner = ImageFolder(root, **({"stage_size": stage_size} if stage_size else {}))
+    # cache sized to hold the whole tree (+25% slack): the timed epoch is
+    # then the decode-once steady state
+    cache_mb = max(
+        64, int(n_images * inner.stage_h * inner.stage_w * 3 * 1.25 / 2**20)
+    )
+    dataset = CachedDataset(inner, cache_mb)
     fused, state = build_v2_fused_step(config, mesh)
 
     def run_epoch(epoch, max_steps):
         nonlocal state
         n = 0
         metrics = None
-        loader = epoch_loader(dataset, epoch, 0, batch, mesh)
+        loader = epoch_loader(dataset, epoch, 0, batch, mesh,
+                              workers=workers, depth=depth, trim_h2d=True)
         try:
             for imgs, _labels, extents in loader:
                 state, metrics = fused(state, imgs, extents, n)
@@ -461,12 +510,15 @@ def bench_e2e():
         return n
 
     t_c = time.perf_counter()
-    run_epoch(0, 2)  # compile + relay warmup
+    # warm a FULL epoch: compiles the (one, trimmed) step shape AND fills
+    # the decode-once cache, so the timed epoch measures steady state
+    run_epoch(0, n_images // batch)
     compile_warmup_s = time.perf_counter() - t_c
     t0 = time.perf_counter()
     n = run_epoch(1, steps)
     dt = time.perf_counter() - t0
     per_chip = batch * n / dt / n_chips
+    lookups = dataset.hits + dataset.misses
     print(
         json.dumps(
             {
@@ -479,6 +531,15 @@ def bench_e2e():
                 # evidence for sizing the TPU window (VERDICT r4 #2): how
                 # long compile+warmup actually took on THIS backend
                 "compile_warmup_s": round(compile_warmup_s, 1),
+                # the ISSUE 3 pipeline shape this number was measured with
+                "input_pipeline": {
+                    "staging_workers": workers,
+                    "prefetch_depth": depth,
+                    "input_cache_mb": cache_mb,
+                    "h2d_trim": True,
+                    "cache_hit_rate": round(dataset.hits / lookups, 3)
+                    if lookups else 0.0,
+                },
             }
         )
     )

@@ -70,6 +70,30 @@ class PretrainConfig:
                                       # stages typical ImageNet photos at
                                       # ORIGINAL resolution so the on-device
                                       # RRC samples original pixels)
+    # input pipeline (ISSUE 3: parallel sharded staging, decode-once cache,
+    # overlapped H2D — see README "Input pipeline" for tuning)
+    prefetch_depth: int = 2           # device batches staged ahead of the
+                                      # consumer (Prefetcher queue capacity;
+                                      # each slot pins one batch of HBM)
+    staging_workers: int = 4          # host staging threads per Prefetcher:
+                                      # each decodes a disjoint sub-slice of
+                                      # the per-host batch into a pooled
+                                      # canvas (bit-identical to 1 worker)
+    input_cache_mb: int = 0           # decode-once canvas cache budget in
+                                      # MiB (LRU over uint8 canvases +
+                                      # extents; 0 = off). Sound because the
+                                      # randomized augmentation runs ON
+                                      # DEVICE over the staging canvas, so
+                                      # the decoded canvas is deterministic
+                                      # per image — epochs >= 2 pay memcpy
+                                      # instead of JPEG decode
+    h2d_trim: bool = False            # slice each staged batch to its max
+                                      # content extent (rounded up to 64)
+                                      # before the device transfer: fewer
+                                      # H2D bytes + cheaper on-device aug
+                                      # for content that underfills the
+                                      # canvas. Single-host only; each new
+                                      # trimmed shape compiles once
     # optimization (reference: SGD momentum .9, wd 1e-4, lr .03, batch 256)
     optimizer: str = "sgd"            # sgd | adamw | lars
     lr: float = 0.03                  # absolute lr; 0.0 = derive from base_lr
@@ -155,6 +179,23 @@ class PretrainConfig:
     knn_bank_size: int = 4096         # monitor bank cap (train-subset size)
     num_classes: int = 1000           # dataset classes (kNN/eval only)
 
+    def __post_init__(self):
+        # config-BUILD-time validation (runs again on every replace()): a
+        # bad depth/worker count must fail where it was written, not as a
+        # wedged queue half an epoch into a run
+        if self.prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {self.prefetch_depth}"
+            )
+        if self.staging_workers < 1:
+            raise ValueError(
+                f"staging_workers must be >= 1, got {self.staging_workers}"
+            )
+        if self.input_cache_mb < 0:
+            raise ValueError(
+                f"input_cache_mb must be >= 0, got {self.input_cache_mb}"
+            )
+
     def replace(self, **kw) -> "PretrainConfig":
         return dataclasses.replace(self, **kw)
 
@@ -188,6 +229,8 @@ class EvalConfig:
     num_classes: int = 1000
     num_workers: int = 0              # host-side loader threads (-j); 0 = default (8)
     stage_size: int = 0               # staging canvas shorter side (0 = default)
+    prefetch_depth: int = 2           # batches staged ahead (epoch_loader)
+    staging_workers: int = 4          # host staging threads per Prefetcher
     seed: int = 0
     # lincls recipe: lr 30, epochs 100, milestones 60/80, wd 0, batch 256
     lr: float = 30.0                  # absolute lr; 0.0 = derive from base_lr
@@ -210,6 +253,16 @@ class EvalConfig:
     evaluate: bool = False                # -e/--evaluate: validate the
                                           # (resumed) probe and exit, no
                                           # training (`main_lincls.py:≈L95`)
+
+    def __post_init__(self):
+        if self.prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {self.prefetch_depth}"
+            )
+        if self.staging_workers < 1:
+            raise ValueError(
+                f"staging_workers must be >= 1, got {self.staging_workers}"
+            )
 
     def replace(self, **kw) -> "EvalConfig":
         return dataclasses.replace(self, **kw)

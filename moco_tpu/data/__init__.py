@@ -9,8 +9,10 @@ from moco_tpu.data.augment import (
     v2_aug_config,
     v3_aug_configs,
 )
+from moco_tpu.data.canvas_cache import CachedDataset
 from moco_tpu.data.datasets import CIFAR10, ImageFolder, SyntheticDataset, build_dataset
 from moco_tpu.data.loader import Prefetcher, epoch_loader, epoch_permutation, host_shard
+from moco_tpu.data.stats import InputPipelineStats
 
 __all__ = [
     "AugConfig",
@@ -22,8 +24,10 @@ __all__ = [
     "v1_aug_config",
     "v2_aug_config",
     "v3_aug_configs",
+    "CachedDataset",
     "CIFAR10",
     "ImageFolder",
+    "InputPipelineStats",
     "SyntheticDataset",
     "build_dataset",
     "Prefetcher",

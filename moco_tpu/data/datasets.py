@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
@@ -237,9 +238,13 @@ class ImageFolder:
         self._native_workers = num_workers
         # cumulative decode telemetry (read by the train driver every step):
         # failures substitute zero canvases, which poison training silently —
-        # the driver meters the rate and aborts past config.decode_abort_rate
+        # the driver meters the rate and aborts past config.decode_abort_rate.
+        # Locked: staging workers (ISSUE 3) decode disjoint sub-slices of one
+        # batch concurrently, and a lost increment would understate the very
+        # failure rate the abort threshold watches.
         self.decode_failures = 0
         self.decode_total = 0
+        self._meter_lock = threading.Lock()
         classes = sorted(
             d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
         )
@@ -333,24 +338,45 @@ class ImageFolder:
             return canvas, extent, 1
 
     def get_batch(self, indices: np.ndarray):
+        out = np.empty(
+            (len(indices), self.stage_h, self.stage_w, 3), np.uint8
+        )
+        extents = np.empty((len(indices), 3), np.int32)
+        labels = self.get_batch_into(indices, out, extents)
+        return out, labels, extents
+
+    def get_batch_into(self, indices, out_imgs: np.ndarray,
+                       out_extents: np.ndarray) -> np.ndarray:
+        """Decode `indices` INTO caller-owned rows (ISSUE 3 staging-canvas
+        protocol); returns the labels. `out_imgs` is `[n, stage_h, stage_w,
+        3] uint8`, `out_extents` `[n, 3] int32` — typically disjoint row
+        ranges of a pooled staging canvas, so the native path's decode
+        threads write the final bytes in place (zero assembly copies).
+        Thread-safe: concurrent calls for disjoint rows share the native
+        pool and the decode meters."""
         idx = [int(i) for i in indices]
         paths = [self.entries[i].path for i in idx]
-        self.decode_total += len(idx)
+        with self._meter_lock:
+            self.decode_total += len(idx)
         if self._native is not None and all(
             p.lower().endswith((".jpg", ".jpeg")) for p in paths
         ):
-            imgs, extents, failures = self._native.load_batch(paths)
+            _, _, failures = self._native.load_batch(
+                paths, out=out_imgs, extents=out_extents
+            )
             if failures == 0:
-                return imgs, self.labels[indices], extents
+                return self.labels[np.asarray(idx)]
             # native failures: retry the whole batch via PIL — it decodes
             # some streams libjpeg rejects, and pinpoints the bad file(s)
         staged = list(self._pool.map(self._load_one_tolerant, idx))
         failed = sum(s[2] for s in staged)
         if failed:
-            self.decode_failures += failed
-        imgs = np.stack([s[0] for s in staged])
-        extents = np.stack([s[1] for s in staged])
-        return imgs, self.labels[indices], extents
+            with self._meter_lock:
+                self.decode_failures += failed
+        for j, s in enumerate(staged):
+            out_imgs[j] = s[0]
+            out_extents[j] = s[1]
+        return self.labels[np.asarray(idx)]
 
 
 def build_dataset(

@@ -67,26 +67,57 @@ class NativeStagingLoader:
             ctypes.POINTER(ctypes.c_int32),
         ]
         self._lib.sl_destroy.argtypes = [ctypes.c_void_p]
+        try:
+            self._lib.sl_version.restype = ctypes.c_int
+            self.version = int(self._lib.sl_version())
+        except AttributeError:  # pre-v2 .so without the symbol
+            self.version = 1
         if num_threads is None:
             num_threads = max(os.cpu_count() or 1, 1)
+        self.num_threads = num_threads
         self.stage_h = stage_h
         self.stage_w = stage_w
         # cumulative decode telemetry: a zero-canvas batch poisoning training
         # must be VISIBLE (metered by the driver, ISSUE 1 satellite), not a
-        # discarded return value
+        # discarded return value. Locked: staging workers (ISSUE 3) call
+        # load_batch concurrently for disjoint sub-slices of one batch.
         self.total_images = 0
         self.total_failures = 0
+        self._meter_lock = threading.Lock()
         self._handle = self._lib.sl_create(num_threads, stage_h, stage_w)
         if not self._handle:
             raise RuntimeError("sl_create failed")
 
-    def load_batch(self, paths: list[str]) -> tuple[np.ndarray, np.ndarray, int]:
+    def load_batch(
+        self,
+        paths: list[str],
+        out: np.ndarray | None = None,
+        extents: np.ndarray | None = None,
+    ) -> tuple[np.ndarray, np.ndarray, int]:
         """Decode `paths` in parallel →
         (`[n, H, W, 3] uint8`, `[n, 3] int32 (h, w, rot)`, n_failures).
-        Failed images come back as zero canvases with full-canvas extent."""
+        Failed images come back as zero canvases with full-canvas extent.
+
+        `out`/`extents` let the caller own the destination (ISSUE 3: staging
+        workers hand in disjoint row ranges of a shared pooled canvas, so the
+        decode writes land in place with no per-image Python round-trips and
+        no assembly copy). They must be C-contiguous with the exact shapes
+        below; omitted, fresh arrays are allocated."""
         n = len(paths)
-        out = np.empty((n, self.stage_h, self.stage_w, 3), dtype=np.uint8)
-        extents = np.empty((n, 3), dtype=np.int32)
+        if out is None:
+            out = np.empty((n, self.stage_h, self.stage_w, 3), dtype=np.uint8)
+        if extents is None:
+            extents = np.empty((n, 3), dtype=np.int32)
+        if out.shape != (n, self.stage_h, self.stage_w, 3) or out.dtype != np.uint8:
+            raise ValueError(
+                f"out must be uint8 [{n}, {self.stage_h}, {self.stage_w}, 3], "
+                f"got {out.dtype} {out.shape}"
+            )
+        if extents.shape != (n, 3) or extents.dtype != np.int32:
+            raise ValueError(f"extents must be int32 [{n}, 3], got "
+                             f"{extents.dtype} {extents.shape}")
+        if not out.flags.c_contiguous or not extents.flags.c_contiguous:
+            raise ValueError("out/extents must be C-contiguous")
         arr = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
         failures = self._lib.sl_load_batch(
             self._handle,
@@ -96,9 +127,11 @@ class NativeStagingLoader:
             extents.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         )
         failures = int(failures)
-        self.total_images += n
+        with self._meter_lock:
+            self.total_images += n
+            if failures:
+                self.total_failures += failures
         if failures:
-            self.total_failures += failures
             log_event(
                 "data",
                 f"native decode: {failures}/{n} failure(s) in batch "

@@ -285,7 +285,9 @@ def train_lincls(config: EvalConfig, mesh=None, max_steps: int | None = None):
         losses = AverageMeter("Loss", ":.4e")
         top1 = AverageMeter("Acc@1", ":6.2f")
         progress = ProgressMeter(steps_per_epoch, [losses, top1], f"Epoch: [{epoch}]")
-        loader = epoch_loader(train_set, epoch, config.seed, config.batch_size, mesh)
+        loader = epoch_loader(train_set, epoch, config.seed, config.batch_size,
+                              mesh, depth=config.prefetch_depth,
+                              workers=config.staging_workers)
         try:
             for i, (imgs, labels, extents) in enumerate(loader):
                 images = augment_batch(

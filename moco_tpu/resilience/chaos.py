@@ -27,6 +27,7 @@ from __future__ import annotations
 import contextlib
 import os
 import signal
+import threading
 from dataclasses import dataclass, field
 
 from moco_tpu.resilience.errors import TransientDataError
@@ -51,6 +52,11 @@ class ChaosPlan:
     _fired: set = field(default_factory=set, repr=False)
     _nans_raised: int = field(default=0, repr=False)
     _loader_errors_raised: int = field(default=0, repr=False)
+    # loader faults are polled CONCURRENTLY by the staging workers
+    # (ISSUE 3): an unsynchronized check-then-increment would let two
+    # workers both observe the budget unspent and inject more faults than
+    # the plan configured
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def _fire_once(self, key: str) -> bool:
         if key in self._fired:
@@ -82,16 +88,22 @@ class ChaosPlan:
     def maybe_loader_error(self, batch_index: int) -> None:
         """Raise `TransientDataError` for the first `loader_error_count`
         attempts at the configured batch — the retry-with-backoff path must
-        survive exactly that many consecutive failures."""
-        if (
-            self.loader_error_at_batch == batch_index
-            and self._loader_errors_raised < self.loader_error_count
-        ):
+        survive exactly that many consecutive failures. Thread-safe: with
+        multi-worker staging the fault budget is spent exactly
+        `loader_error_count` times across all workers (which worker draws
+        a fault is scheduler-dependent; the batch-level scenario — N
+        transient faults at batch b, then recovery — stays deterministic)."""
+        if self.loader_error_at_batch != batch_index:
+            return
+        with self._lock:
+            if self._loader_errors_raised >= self.loader_error_count:
+                return
             self._loader_errors_raised += 1
-            raise TransientDataError(
-                f"chaos: injected read failure {self._loader_errors_raised}/"
-                f"{self.loader_error_count} at batch {batch_index}"
-            )
+            n = self._loader_errors_raised
+        raise TransientDataError(
+            f"chaos: injected read failure {n}/"
+            f"{self.loader_error_count} at batch {batch_index}"
+        )
 
 
 _INT_FIELDS = (

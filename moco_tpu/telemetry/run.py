@@ -30,6 +30,7 @@ from moco_tpu.telemetry.registry import (
     Heartbeat,
     MetricsRegistry,
 )
+from moco_tpu.data.stats import InputPipelineStats
 from moco_tpu.telemetry.timing import StepPhaseTimer
 from moco_tpu.utils import logging as mlog
 
@@ -53,6 +54,10 @@ class RunTelemetry:
             if is_main else None
         )
         self.timer = StepPhaseTimer(stride=config.telemetry_stride)
+        # input-pipeline counters (ISSUE 3): threaded into every Prefetcher
+        # and CachedDataset of the run by the driver; snapshots ride the
+        # step records at the device-sampling stride
+        self.input_stats = InputPipelineStats()
         self.mfu = MFUEstimator.for_config(
             config, n_chips, getattr(device, "device_kind", "")
         )
@@ -118,6 +123,10 @@ class RunTelemetry:
             if "hbm_peak_bytes" in sampled:
                 self._hbm_gauge.set(sampled["hbm_peak_bytes"])
             self.pod.update(**sampled)
+            if self.input_stats.staged_batches:
+                # queue depth / cache hit rate / staged-batch latency /
+                # worker busy fraction, cumulative for the run so far
+                record["input"] = self.input_stats.snapshot()
         self.pod.update(
             step_s=phases["step_s"], data_s=phases["data_s"],
             imgs_per_sec=rolling, incidents=self._incidents.value,
@@ -157,6 +166,8 @@ class RunTelemetry:
             summary["mfu_mean"] = round(self._mfu_hist.mean, 5)
         if self._hbm_gauge.high_water > float("-inf"):
             summary["hbm_peak_bytes"] = int(self._hbm_gauge.high_water)
+        if self.input_stats.staged_batches:
+            summary["input"] = self.input_stats.snapshot()
         summary.update(extra_summary)
         self.registry.emit("run_end", **summary)
         if self.heartbeat is not None:

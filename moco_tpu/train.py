@@ -340,6 +340,17 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
         )
         if _telemetry_out is not None:
             _telemetry_out.append(telemetry)
+    input_stats = telemetry.input_stats if telemetry is not None else None
+
+    if config.input_cache_mb:
+        # decode-once canvas cache (ISSUE 3): wrapped per driver pass, so a
+        # NaN rollback restarts it cold (safe — it is index-keyed, carries
+        # no positional state, and the skipped window is simply never asked
+        # for). Lives OUTSIDE the epoch loop: epochs >= 2 are the payoff.
+        from moco_tpu.data.canvas_cache import CachedDataset
+
+        dataset = CachedDataset(dataset, config.input_cache_mb,
+                                stats=input_stats)
 
     model = build_encoder(config)
     tx, sched = build_optimizer(config, steps_per_epoch)
@@ -538,6 +549,8 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                 dataset, epoch, config.seed, config.batch_size, mesh,
                 skip_batches=skip, retries=config.loader_retries,
                 backoff_secs=config.loader_backoff_secs,
+                depth=config.prefetch_depth, workers=config.staging_workers,
+                stats=input_stats, trim_h2d=config.h2d_trim,
             )
             end = time.perf_counter()
             if telemetry is not None:

@@ -21,6 +21,7 @@
 // of the input path that cannot run on the TPU.
 //
 // C ABI (consumed via ctypes from moco_tpu/data/native_loader.py):
+//   int   sl_version();  // ABI/behavior revision (2 = chunked batch fan-out)
 //   void* sl_create(int num_threads, int stage_h, int stage_w);
 //   int   sl_load_batch(void* h, const char** paths, int n, uint8_t* out,
 //                       int32_t* extents);
@@ -28,6 +29,15 @@
 //         // returns 0 on success, else the number of failed images
 //         // (failed slots are zero-filled with full-canvas extent)
 //   void  sl_destroy(void* h);
+//
+// Scheduling (v2, ISSUE 3): the batch is fanned out as ONE task per
+// CONTIGUOUS CHUNK (min(num_threads, n) chunks), not one task per image.
+// Per-image tasks paid a mutex acquire + condition-variable wake per image
+// (256 lock round-trips per batch), and every image re-malloc'd its decode
+// buffer; chunked tasks touch the queue lock num_threads times per batch
+// and reuse one RGB scratch buffer across the whole chunk. Concurrent
+// sl_load_batch calls on one handle are safe: each call owns its own
+// completion state, and the pool queue is the only shared structure.
 
 #include <cstdio>  // must precede jpeglib.h (it needs FILE declared)
 
@@ -231,14 +241,18 @@ class ThreadPool {
 
 struct Loader {
   ThreadPool pool;
+  int num_threads;
   int stage_h;
   int stage_w;
-  Loader(int threads, int h, int w) : pool(threads), stage_h(h), stage_w(w) {}
+  Loader(int threads, int h, int w)
+      : pool(threads), num_threads(threads), stage_h(h), stage_w(w) {}
 };
 
 }  // namespace
 
 extern "C" {
+
+int sl_version() { return 2; }
 
 void* sl_create(int num_threads, int stage_h, int stage_w) {
   if (num_threads < 1 || stage_h < 1 || stage_w < 1) return nullptr;
@@ -251,27 +265,35 @@ int sl_load_batch(void* handle, const char** paths, int n, uint8_t* out,
   const int H = loader->stage_h;
   const int W = loader->stage_w;
   const size_t tile = static_cast<size_t>(H) * W * 3;
+  const int chunks = std::max(1, std::min(loader->num_threads, n));
   std::atomic<int> failures{0};
   // `remaining` is a plain int guarded by done_mu: the decrement must happen
   // UNDER the lock, otherwise the waiter can observe 0 (spurious wake) and
   // destroy these stack objects while the last worker is still about to
   // lock them (use-after-free).
-  int remaining = n;
+  int remaining = chunks;
   std::mutex done_mu;
   std::condition_variable done_cv;
-  for (int i = 0; i < n; ++i) {
-    loader->pool.Submit([&, i] {
-      std::vector<uint8_t> rgb;
-      int w = 0, h = 0;
-      if (decode_jpeg(paths[i], &rgb, &w, &h) && w > 0 && h > 0) {
-        stage_rect(rgb.data(), w, h, H, W, out + i * tile, extents + i * 3);
-      } else {
-        std::memset(out + i * tile, 0, tile);
-        extents[i * 3] = H;
-        extents[i * 3 + 1] = W;
-        extents[i * 3 + 2] = 0;
-        failures.fetch_add(1);
+  for (int c = 0; c < chunks; ++c) {
+    // balanced contiguous ranges: image i belongs to chunk i*chunks/n
+    const int lo = static_cast<int>(static_cast<int64_t>(n) * c / chunks);
+    const int hi = static_cast<int>(static_cast<int64_t>(n) * (c + 1) / chunks);
+    loader->pool.Submit([&, lo, hi] {
+      std::vector<uint8_t> rgb;  // scratch reused across the chunk's images
+      int chunk_failures = 0;
+      for (int i = lo; i < hi; ++i) {
+        int w = 0, h = 0;
+        if (decode_jpeg(paths[i], &rgb, &w, &h) && w > 0 && h > 0) {
+          stage_rect(rgb.data(), w, h, H, W, out + i * tile, extents + i * 3);
+        } else {
+          std::memset(out + i * tile, 0, tile);
+          extents[i * 3] = H;
+          extents[i * 3 + 1] = W;
+          extents[i * 3 + 2] = 0;
+          ++chunk_failures;
+        }
       }
+      if (chunk_failures) failures.fetch_add(chunk_failures);
       {
         std::lock_guard<std::mutex> lk(done_mu);
         if (--remaining == 0) done_cv.notify_one();

@@ -68,3 +68,71 @@ def test_print_allowed_in_logging_and_meters(tmp_path):
         path.parent.mkdir(parents=True, exist_ok=True)
         path.write_text("print('the channel itself')\n")
         assert lint.check_file(str(path)) == []
+
+
+def test_r4_detects_unclosed_loader(tmp_path):
+    """R4 (ISSUE 3): a Prefetcher/epoch_loader construction with no
+    close()/close_quietly() in a finally leaks staging threads."""
+    (tmp_path / "leaky.py").write_text(
+        "def run(ds, mesh):\n"
+        "    loader = epoch_loader(ds, 0, 0, 16, mesh)\n"
+        "    for b in loader:\n"
+        "        pass\n"
+    )
+    found = lint.check_file(str(tmp_path / "leaky.py"))
+    assert len(found) == 1
+    assert ":2:" in found[0] and "finally" in found[0]
+
+
+def test_r4_accepts_closed_loader_and_factory_return(tmp_path):
+    (tmp_path / "clean.py").write_text(
+        "def run(ds, mesh):\n"
+        "    loader = epoch_loader(ds, 0, 0, 16, mesh)\n"
+        "    try:\n"
+        "        for b in loader:\n"
+        "            pass\n"
+        "    finally:\n"
+        "        loader.close_quietly()\n"
+        "\n"
+        "def factory(ds, idx, mesh):\n"
+        "    return Prefetcher(ds, idx, 16, mesh)\n"
+    )
+    assert lint.check_file(str(tmp_path / "clean.py")) == []
+
+
+def test_r4_flags_unbound_construction(tmp_path):
+    (tmp_path / "unbound.py").write_text(
+        "def run(ds, idx, mesh):\n"
+        "    return list(Prefetcher(ds, idx, 16, mesh))\n"
+    )
+    found = lint.check_file(str(tmp_path / "unbound.py"))
+    assert len(found) == 1
+    assert "without binding a name" in found[0]
+
+
+def test_r4_close_in_wrong_scope_still_flagged(tmp_path):
+    """A finally in a DIFFERENT function does not discharge the
+    construction site's obligation."""
+    (tmp_path / "cross.py").write_text(
+        "def make(ds, mesh):\n"
+        "    loader = epoch_loader(ds, 0, 0, 16, mesh)\n"
+        "    return loader\n"
+        "\n"
+        "def other(loader):\n"
+        "    try:\n"
+        "        pass\n"
+        "    finally:\n"
+        "        loader.close()\n"
+    )
+    found = lint.check_file(str(tmp_path / "cross.py"))
+    assert len(found) == 1 and ":2:" in found[0]
+
+
+def test_r4_holds_for_bench_and_package_call_sites():
+    """The real construction sites (train driver, lincls, bench.py — the
+    latter outside the package tree, held to R4 here) stay clean."""
+    for rel in ("moco_tpu/train.py", "moco_tpu/evals/lincls.py", "bench.py"):
+        path = os.path.join(REPO, rel)
+        r4_only = [v for v in lint.check_file(path) if "finally" in v
+                   or "without binding" in v]
+        assert r4_only == [], r4_only

@@ -425,6 +425,9 @@ def telemetry_run(mesh8, tmp_path_factory):
         telemetry_dir=str(tmp_path / "telemetry"),
         telemetry_flush_steps=8, telemetry_stride=5,
         peak_flops_per_chip=1e12,  # CPU has no table entry; MFU needs a basis
+        # ISSUE 3: the smoke also exercises the parallel staging pipeline +
+        # decode-once cache, so input metrics land in the same stream
+        staging_workers=2, input_cache_mb=64,
     )
     from moco_tpu.train import train
 
@@ -466,6 +469,46 @@ def test_train_30_steps_writes_parseable_events(telemetry_run):
     assert len(ends) == 1
     assert ends[0]["steps"] == 30 and ends[0]["scalar_drops"] == 0
     assert ends[0]["step_s_p50"] > 0
+
+
+def test_input_pipeline_metrics_in_events(telemetry_run):
+    """ISSUE 3 acceptance: queue depth, cache hit rate, and staged-batch
+    latency appear in events.jsonl (step records at the sampling stride +
+    the run_end summary)."""
+    config, _, _ = telemetry_run
+    events_path = os.path.join(config.telemetry_dir, "events.jsonl")
+    records, _ = report.load_events(events_path)
+    steps = [r for r in records if r["kind"] == "step"]
+    snaps = [r["input"] for r in steps if "input" in r]
+    assert snaps, "no step record carried an input snapshot"
+    for snap in snaps:
+        assert snap["staged_batches"] > 0
+        assert snap["workers"] == 2
+        assert snap["queue_depth"] >= 0 and snap["queue_depth_mean"] >= 0
+        assert snap["staged_batch_s_p95"] >= snap["staged_batch_s_p50"] > 0
+        assert 0 <= snap["worker_busy_frac"] <= 1
+        assert "cache_hit_rate" in snap  # the cache wrap was active
+    end = [r for r in records if r["kind"] == "run_end"][-1]
+    assert end["input"]["staged_batches"] >= snaps[-1]["staged_batches"]
+
+
+def test_report_renders_input_pipeline(telemetry_run):
+    config, _, _ = telemetry_run
+    events_path = os.path.join(config.telemetry_dir, "events.jsonl")
+    proc = subprocess.run(
+        [sys.executable, REPORT, events_path], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "input:" in proc.stdout
+    assert "staged-batch latency" in proc.stdout
+    assert "decode-once cache" in proc.stdout
+    as_json = subprocess.run(
+        [sys.executable, REPORT, events_path, "--json"],
+        capture_output=True, text=True,
+    )
+    summary = json.loads(as_json.stdout)
+    assert summary["input"]["staged_batches"] > 0
+    assert "cache_hit_rate" in summary["input"]
 
 
 def test_heartbeat_written(telemetry_run):

@@ -19,9 +19,17 @@ Rules, AST-enforced over every .py file under the package:
       structured channel (`log_event` → telemetry events.jsonl) and the
       one sanctioned plain-line path (`logging.info`), so an external
       monitor can never consume it.
+  R4  (ISSUE 3) every `Prefetcher(...)` / `epoch_loader(...)` construction
+      bound to a name must have a `finally` in the same function calling
+      `<name>.close()` or `<name>.close_quietly()` — the staging threads
+      and `depth` device batches leak otherwise (the class of leak ISSUE 1
+      fixed by hand at every call site, now enforced). A construction
+      returned directly (`return Prefetcher(...)`) is the factory pattern
+      and exempt: the caller owns the close.
 
 Exit 0 when clean; exit 1 with one `path:line: message` per violation.
-Runs in tier-1 via tests/test_lint_robustness.py.
+Runs in tier-1 via tests/test_lint_robustness.py (which also holds
+bench.py to R4 even though it lives outside the package tree).
 """
 
 from __future__ import annotations
@@ -35,6 +43,85 @@ BROAD = {"Exception", "BaseException"}
 # the only files allowed to call print(): the structured/sanctioned
 # channels themselves (log_event/info) and the console meters
 PRINT_ALLOWED = ("utils/logging.py", "utils/meters.py")
+
+# R4: constructors whose result owns background staging threads
+LOADER_FACTORIES = {"Prefetcher", "epoch_loader"}
+
+
+def _call_name(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _r4_scope_violations(scope: ast.AST, path: str) -> list[str]:
+    """R4 within one function (or module) body, NOT descending into nested
+    function definitions (each is its own scope with its own finallys)."""
+
+    def walk_shallow(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            yield child
+            yield from walk_shallow(child)
+
+    constructions: list[tuple[str | None, int]] = []
+    closed_in_finally: set[str] = set()
+    for node in walk_shallow(scope):
+        if isinstance(node, ast.Call) and _call_name(node.func) in LOADER_FACTORIES:
+            parent = getattr(node, "_r4_parent", None)
+            if isinstance(parent, ast.Return):
+                continue  # factory pattern: the caller owns the close
+            if (isinstance(parent, ast.Assign)
+                    and len(parent.targets) == 1
+                    and isinstance(parent.targets[0], ast.Name)):
+                constructions.append((parent.targets[0].id, node.lineno))
+            else:
+                constructions.append((None, node.lineno))
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                for call in ast.walk(stmt):
+                    if (isinstance(call, ast.Call)
+                            and isinstance(call.func, ast.Attribute)
+                            and call.func.attr in ("close", "close_quietly")
+                            and isinstance(call.func.value, ast.Name)):
+                        closed_in_finally.add(call.func.value.id)
+    out = []
+    for var, lineno in constructions:
+        if var is None:
+            out.append(
+                f"{path}:{lineno}: Prefetcher/epoch_loader constructed "
+                "without binding a name — the staging threads can never be "
+                "close()d; bind it and close in a finally"
+            )
+        elif var not in closed_in_finally:
+            out.append(
+                f"{path}:{lineno}: `{var} = ...` builds a Prefetcher but no "
+                f"`finally` in this function calls `{var}.close()`/"
+                f"`{var}.close_quietly()` — an early break leaks the "
+                "staging threads and the staged batches"
+            )
+    return out
+
+
+def _r4_check(tree: ast.AST, path: str) -> list[str]:
+    # annotate each Call with its immediate parent so the Return/Assign
+    # context is known at the Call
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call):
+                child._r4_parent = node
+    out = []
+    scopes = [tree] + [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        out.extend(_r4_scope_violations(scope, path))
+    return out
 
 
 def _names(node: ast.expr | None):
@@ -71,6 +158,12 @@ def check_file(path: str) -> list[str]:
     print_allowed = os.path.normpath(path).replace(os.sep, "/").endswith(
         PRINT_ALLOWED
     )
+    # R4 everywhere except the defining module itself (its factory returns
+    # and self-methods are the ownership boundary the rule protects)
+    if not os.path.normpath(path).replace(os.sep, "/").endswith(
+        "data/loader.py"
+    ):
+        out.extend(_r4_check(tree, path))
     for node in ast.walk(tree):
         if (
             not print_allowed

@@ -11,6 +11,8 @@ Renders, from the schema-versioned record stream the driver writes
   - MFU (mean/max) and the peak-FLOPs assumption it was judged against
   - throughput (rolling at end-of-run, cumulative mean)
   - HBM high-water mark + host-RSS high-water
+  - input pipeline (ISSUE 3): prefetch queue depth, staging-worker busy
+    fraction, decode-once cache hit rate, staged-batch latency p50/p95
   - incident counts by event kind (preempt/rollback/chaos/watchdog/...)
   - pod-record count and worst cross-host step-time spread
 
@@ -132,6 +134,13 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
         summary["hbm_high_water_bytes"] = int(max(hbm))
     if rss:
         summary["host_rss_high_water_bytes"] = int(max(rss))
+    # input-pipeline snapshots are cumulative — the LAST one (run_end wins
+    # over the last sampled step) summarizes the whole run
+    input_snaps = [r["input"] for r in steps if isinstance(r.get("input"), dict)]
+    if run_ends and isinstance(run_ends[-1].get("input"), dict):
+        input_snaps.append(run_ends[-1]["input"])
+    if input_snaps:
+        summary["input"] = input_snaps[-1]
     if pods:
         spreads = [
             p["step_s_max"] - p["step_s_min"]
@@ -209,6 +218,26 @@ def render(summary: dict) -> str:
             f"host RSS high-water: "
             f"{summary['host_rss_high_water_bytes'] / 2**30:.2f} GiB"
         )
+    inp = summary.get("input")
+    if inp:
+        lines.append(
+            f"input: {inp.get('staged_batches', 0)} staged batches "
+            f"({inp.get('staged_mb', 0):.0f} MiB) · queue depth mean "
+            f"{inp.get('queue_depth_mean', 0):.2f} · "
+            f"{inp.get('workers', 1)} worker(s) busy "
+            f"{100 * inp.get('worker_busy_frac', 0):.1f}%"
+        )
+        lines.append(
+            f"  staged-batch latency: p50 "
+            f"{1e3 * inp.get('staged_batch_s_p50', 0):.1f} ms · p95 "
+            f"{1e3 * inp.get('staged_batch_s_p95', 0):.1f} ms"
+        )
+        if "cache_hit_rate" in inp:
+            lines.append(
+                f"  decode-once cache: {100 * inp['cache_hit_rate']:.1f}% hit "
+                f"({inp.get('cache_hits', 0)} hit / "
+                f"{inp.get('cache_misses', 0)} miss)"
+            )
     if "pod_step_spread_ms_max" in summary:
         lines.append(
             f"pod: {summary['pod_records']} records, worst cross-host step "
