@@ -49,7 +49,11 @@ def _rekey(state: TrainState) -> TrainState:
 
 
 def _position_path(directory: str, step: int) -> str:
-    return os.path.join(directory, ".position", f"{step}.json")
+    # layout shared with the supervisor's quarantine preflight — defined
+    # once in the stdlib-only integrity module
+    from moco_tpu.resilience.integrity import position_path
+
+    return position_path(directory, step)
 
 
 def write_position(directory: str, step: int,

@@ -123,8 +123,13 @@ class PretrainConfig:
     telemetry_dir: str = ""           # events.jsonl + heartbeat.json land
                                       # here ("" = telemetry off; no step-
                                       # loop overhead when off)
-    telemetry_flush_steps: int = 50   # buffered-record flush (+ heartbeat)
-                                      # cadence, in step records
+    telemetry_flush_steps: int = 50   # buffered-record flush cadence, in
+                                      # step records
+    heartbeat_secs: float = 1.0       # min seconds between heartbeat.json
+                                      # writes (beaten every step, time-
+                                      # gated; the supervisor's hang-
+                                      # detection granularity — independent
+                                      # of the flush cadence above)
     telemetry_stride: int = 16        # device-fence sampling stride: every
                                       # N steps block_until_ready measures
                                       # the device-compute phase and HBM is

@@ -17,8 +17,15 @@ this package makes that survivable without a babysitter:
   `max_rollbacks` consecutive rollbacks.
 - `watchdog.StepWatchdog` — flags step-time hangs from a background
   thread (a stuck collective on a pod otherwise looks like silence).
+- `supervisor.Supervisor` (ISSUE 4) — the OUT-OF-PROCESS layer for the
+  faults none of the above can observe: SIGKILL-grade preemption, native
+  crashes, OOM kills, and wedged collectives. Runs the driver as a child,
+  kills it on heartbeat staleness, classifies every death via the
+  `exitcodes` protocol + forensics, and restarts within a
+  progress-refunded budget. CLI: tools/supervise.py.
 - `chaos.ChaosPlan` — the deterministic fault-injection harness that
   makes all of the above TESTABLE on CPU: SIGTERM-at-step-k,
+  kill/freeze-at-step-k (process death / wedged-collective simulation),
   NaN-at-step-k, loader faults, checkpoint truncation.
 
 Errors are typed (`errors.py`) so callers can route retryable faults
@@ -41,6 +48,14 @@ from moco_tpu.resilience.errors import (
     RollbackExhaustedError,
     TransientDataError,
 )
+from moco_tpu.resilience.exitcodes import (
+    EXIT_CODE_NAMES,
+    EXIT_CONFIG_ERROR,
+    EXIT_DATA_QUALITY,
+    EXIT_OK,
+    EXIT_PREEMPTED,
+    EXIT_ROLLBACK_EXHAUSTED,
+)
 from moco_tpu.resilience.integrity import (
     manifest_path,
     verify_step,
@@ -48,18 +63,36 @@ from moco_tpu.resilience.integrity import (
 )
 from moco_tpu.resilience.preemption import PreemptionHandler
 from moco_tpu.resilience.sentinel import NaNSentinel
+from moco_tpu.resilience.supervisor import (
+    RestartPolicy,
+    Supervisor,
+    SupervisorResult,
+    classify_exit,
+    preflight_resume,
+)
 from moco_tpu.resilience.watchdog import StepWatchdog
 
 __all__ = [
     "ChaosPlan",
     "DataQualityError",
+    "EXIT_CODE_NAMES",
+    "EXIT_CONFIG_ERROR",
+    "EXIT_DATA_QUALITY",
+    "EXIT_OK",
+    "EXIT_PREEMPTED",
+    "EXIT_ROLLBACK_EXHAUSTED",
     "NaNSentinel",
     "NonFiniteLossError",
     "PreemptionHandler",
+    "RestartPolicy",
     "RollbackExhaustedError",
     "StepWatchdog",
+    "Supervisor",
+    "SupervisorResult",
     "TransientDataError",
     "active_chaos",
+    "classify_exit",
+    "preflight_resume",
     "chaos_context",
     "clear_chaos",
     "install_chaos",

@@ -28,6 +28,7 @@ import contextlib
 import os
 import signal
 import threading
+import time
 from dataclasses import dataclass, field
 
 from moco_tpu.resilience.errors import TransientDataError
@@ -38,9 +39,34 @@ from moco_tpu.utils.logging import log_event
 class ChaosPlan:
     """One deterministic fault scenario. Steps count COMPLETED train steps
     (the driver's `global_step` after the increment); batches are the
-    Prefetcher's 0-based batch index within its epoch."""
+    Prefetcher's 0-based batch index within its epoch.
+
+    `state_dir` (set from the MOCO_TPU_CHAOS_STATE env var for env-installed
+    plans) makes fire-once state SURVIVE process death: a `kill_at_step`
+    SIGKILL or a supervisor-killed `freeze_at_step` hang ends the process,
+    and the restarted child — resuming from a checkpoint BEFORE the fault's
+    step — would otherwise re-fire the same fault on every traversal and
+    turn the drill into a crash loop. With a state dir, each FIRE-ONCE
+    fault (sigterm/kill/freeze) drops a marker file before executing and
+    never fires again across restarts. The counted faults (nan_count,
+    loader_error_count) stay per-process by design: their counts exist to
+    model repeated in-process re-traversal (the rollback-exhaustion path),
+    which marker booleans cannot express."""
 
     sigterm_at_step: int | None = None      # deliver SIGTERM after step k
+    kill_at_step: int | None = None         # self-SIGKILL after step k: the
+                                            # un-catchable death (hard
+                                            # preemption, OOM-killer) — no
+                                            # emergency checkpoint, no clean
+                                            # exit; only an out-of-process
+                                            # supervisor can recover it
+    freeze_at_step: int | None = None       # stop dead after step k (no more
+                                            # beats): simulates a wedged pod
+                                            # collective / DCN hang — the
+                                            # silence mode the supervisor's
+                                            # heartbeat-staleness kill exists
+                                            # for. The process sleeps until
+                                            # killed from outside.
     nan_at_step: int | None = None          # poison the reported loss at step k
     nan_count: int = 1                      # re-poison step k on re-traversal
                                             # up to this many times (>1 models
@@ -49,6 +75,10 @@ class ChaosPlan:
                                             # the rollback-exhaustion path)
     loader_error_at_batch: int | None = None  # Prefetcher read fault at batch b
     loader_error_count: int = 1             # consecutive faults before recovery
+    state_dir: str | None = None            # fire-once markers persisted here
+                                            # (supervised drills: faults fire
+                                            # once ACROSS restarts, not once
+                                            # per process)
     _fired: set = field(default_factory=set, repr=False)
     _nans_raised: int = field(default=0, repr=False)
     _loader_errors_raised: int = field(default=0, repr=False)
@@ -61,6 +91,17 @@ class ChaosPlan:
     def _fire_once(self, key: str) -> bool:
         if key in self._fired:
             return False
+        if self.state_dir:
+            # persistent marker, written BEFORE the fault executes: a
+            # kill_at_step SIGKILL gives no later chance to record it, and
+            # an unrecorded fire would re-fire in the restarted child
+            marker = os.path.join(self.state_dir, f"fired_{key}")
+            if os.path.exists(marker):
+                self._fired.add(key)
+                return False
+            os.makedirs(self.state_dir, exist_ok=True)
+            with open(marker, "w") as f:
+                f.write(str(os.getpid()))
         self._fired.add(key)
         return True
 
@@ -70,6 +111,28 @@ class ChaosPlan:
         if self.sigterm_at_step == step and self._fire_once("sigterm"):
             log_event("chaos", f"injecting SIGTERM at step {step}")
             signal.raise_signal(signal.SIGTERM)
+
+    def maybe_kill(self, step: int) -> None:
+        """Self-SIGKILL: the death mode no in-process handler can observe —
+        the kernel never lets the process run again. The in-flight epoch's
+        progress since the last checkpoint is genuinely lost; recovery is
+        the supervisor's restart + `--resume auto`, nothing else."""
+        if self.kill_at_step == step and self._fire_once("kill"):
+            log_event("chaos", f"injecting SIGKILL at step {step}")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_freeze(self, step: int) -> None:
+        """Wedge the process: stop completing steps (and with them the
+        heartbeat) without exiting — exactly what a stuck pod collective
+        looks like from outside. Sleeps until killed; a SIGTERM still runs
+        the preemption handler's flag-setter, but the flag is never polled
+        again, so only the supervisor's SIGTERM→grace→SIGKILL escalation
+        (or an operator) ends it."""
+        if self.freeze_at_step == step and self._fire_once("freeze"):
+            log_event("chaos", f"injecting freeze (wedged-collective "
+                               f"simulation) at step {step}")
+            while True:
+                time.sleep(3600.0)
 
     def maybe_nan(self, step: int) -> bool:
         """True at the configured step (the first `nan_count` traversals of
@@ -108,6 +171,8 @@ class ChaosPlan:
 
 _INT_FIELDS = (
     "sigterm_at_step",
+    "kill_at_step",
+    "freeze_at_step",
     "nan_at_step",
     "nan_count",
     "loader_error_at_batch",
@@ -154,8 +219,13 @@ def active_chaos() -> ChaosPlan | None:
         env = os.environ.get("MOCO_TPU_CHAOS", "")
         if env:
             # env-installed plans persist for the process (fire-once state
-            # must survive multiple polls)
-            install_chaos(parse_chaos_spec(env))
+            # must survive multiple polls); MOCO_TPU_CHAOS_STATE additionally
+            # persists it across PROCESSES — required for supervised drills
+            # whose kill/freeze faults end the process and restart it
+            plan = parse_chaos_spec(env)
+            if plan is not None:
+                plan.state_dir = os.environ.get("MOCO_TPU_CHAOS_STATE") or None
+            install_chaos(plan)
     return _ACTIVE
 
 

@@ -1,0 +1,39 @@
+"""Structured driver exit codes (ISSUE 4 tentpole part 2).
+
+The supervisor restarts a dead child based on WHY it died, and the exit
+code is the only channel that survives every death mode short of SIGKILL.
+The drivers therefore exit through these named constants — never bare
+`sys.exit(<int>)`, which tools/lint_robustness.py rule R5 forbids inside
+the package — so `supervisor.classify_exit` can route each class to its
+restart policy without scraping logs.
+
+The codes start at 43 to stay clear of the shells' own vocabulary
+(0 success, 1 generic python traceback, 2 argparse usage error,
+126/127 exec failures, 128+N signal deaths); a supervisor seeing an
+unknown positive code treats it as a generic crash.
+"""
+
+from __future__ import annotations
+
+EXIT_OK = 0                    # train loop ran to its configured end
+EXIT_PREEMPTED = 43            # SIGTERM/SIGINT honored: emergency checkpoint
+                               # written, clean exit — relaunch resumes it
+EXIT_ROLLBACK_EXHAUSTED = 44   # RollbackExhaustedError: structural divergence,
+                               # restarting would loop — a human has to look
+EXIT_CONFIG_ERROR = 45         # bad preset/flag/config validation: restarting
+                               # the same argv can never succeed
+EXIT_DATA_QUALITY = 46         # DataQualityError: the dataset itself is bad
+                               # (decode-abort threshold); restart won't fix it
+
+# argparse's own usage-error exit — not ours to raise, but the classifier
+# treats it like EXIT_CONFIG_ERROR (same argv can never succeed)
+USAGE_ERROR = 2
+
+EXIT_CODE_NAMES: dict[int, str] = {
+    EXIT_OK: "clean",
+    EXIT_PREEMPTED: "preempted",
+    EXIT_ROLLBACK_EXHAUSTED: "rollback_exhausted",
+    EXIT_CONFIG_ERROR: "config_error",
+    EXIT_DATA_QUALITY: "data_quality",
+    USAGE_ERROR: "usage_error",
+}

@@ -23,7 +23,16 @@ import os
 from moco_tpu.utils.logging import log_event
 
 INTEGRITY_DIRNAME = ".integrity"
+POSITION_DIRNAME = ".position"
 _CHUNK = 1 << 20
+
+
+def position_path(ckpt_dir: str, step: int) -> str:
+    """Path of a step's data-stream position sidecar. Lives here (stdlib-
+    only) rather than checkpoint.py because the jax-free supervisor needs
+    the same layout knowledge for its quarantine preflight — one source of
+    truth for the sidecar scheme."""
+    return os.path.join(ckpt_dir, POSITION_DIRNAME, f"{step}.json")
 
 
 def _digest(path: str) -> str:

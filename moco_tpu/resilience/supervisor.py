@@ -1,0 +1,604 @@
+"""Out-of-process run supervisor (ISSUE 4 tentpole).
+
+PR 1 made the driver survive every fault it can OBSERVE; this module closes
+the loop for the ones it structurally cannot: SIGKILL-grade preemption, a
+segfault in the native staging loader, an OOM-killed process, and the
+silence of a wedged pod collective. The `Supervisor` runs the training
+driver as a child process and:
+
+1. detects HANGS by polling `heartbeat.json` staleness (the every-step,
+   time-gated beat from telemetry) and kills wedged children with a
+   SIGTERM → grace → SIGKILL escalation — SIGTERM first, because a merely
+   slow child still gets its emergency-checkpoint exit;
+2. CLASSIFIES each death from the structured exit-code protocol
+   (resilience/exitcodes.py), the death signal, and an `events.jsonl` tail
+   forensic pass (OOM suspicion from the last RSS samples, native-loader
+   frames);
+3. applies a PER-CLASS restart policy: fatal classes (clean finish,
+   rollback exhausted, config error, data quality) never restart;
+   restartable classes draw on a budget with exponential backoff + jitter,
+   and the budget is REFUNDED whenever the child made step progress since
+   its last launch (read from the heartbeat / checkpoint sidecars) — so a
+   run that keeps advancing restarts indefinitely while a crash loop
+   exhausts the budget in `max_restarts` tries;
+4. runs a resume-integrity PREFLIGHT before each relaunch: every
+   checkpoint step that fails its PR 1 manifest is quarantined out of the
+   directory, so a corrupt emergency checkpoint cannot crash-loop the
+   child through `--resume auto`;
+5. records every lifecycle event (launch, kill, exit classification,
+   backoff, budget state, give-up) as structured `kind: "supervisor"`
+   records appended to the child's own events.jsonl — one stream, rendered
+   by tools/telemetry_report.py.
+
+The CLI wrapper is tools/supervise.py. Everything here is pure stdlib —
+the supervisor must not import jax (it has to stay alive and tiny while
+the child OOMs the machine).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import signal
+import subprocess
+import time
+
+from moco_tpu.resilience.exitcodes import (
+    EXIT_CODE_NAMES,
+    EXIT_CONFIG_ERROR,
+    EXIT_DATA_QUALITY,
+    EXIT_OK,
+    EXIT_PREEMPTED,
+    EXIT_ROLLBACK_EXHAUSTED,
+    USAGE_ERROR,
+)
+from moco_tpu.utils.logging import log_event
+
+EVENTS_FILENAME = "events.jsonl"
+HEARTBEAT_FILENAME = "heartbeat.json"
+QUARANTINE_DIRNAME = ".quarantine"
+
+# -- failure classes ---------------------------------------------------------
+# the supervisor's whole vocabulary: every child death maps to exactly one
+CLASS_CLEAN = "clean"                          # ran to the configured end
+CLASS_PREEMPTED = "preempted"                  # honored SIGTERM, ckpt written
+CLASS_ROLLBACK_EXHAUSTED = "rollback_exhausted"  # structural divergence
+CLASS_CONFIG_ERROR = "config_error"            # same argv can never succeed
+CLASS_DATA_QUALITY = "data_quality"            # dataset itself is bad
+CLASS_HANG = "hang"                            # supervisor killed a stale child
+CLASS_NATIVE_CRASH = "native_crash"            # SIGSEGV/SIGABRT/SIGBUS/...
+CLASS_OOM = "oom"                              # SIGKILL + high tail RSS
+CLASS_KILLED = "killed"                        # external SIGKILL/SIGTERM death
+CLASS_CRASH = "crash"                          # any other nonzero exit
+
+# classes where restarting can never help — the run is OVER
+FATAL_CLASSES = frozenset({
+    CLASS_CLEAN, CLASS_ROLLBACK_EXHAUSTED, CLASS_CONFIG_ERROR,
+    CLASS_DATA_QUALITY,
+})
+RESTARTABLE_CLASSES = frozenset({
+    CLASS_PREEMPTED, CLASS_HANG, CLASS_NATIVE_CRASH, CLASS_OOM,
+    CLASS_KILLED, CLASS_CRASH,
+})
+
+_CRASH_SIGNALS = {
+    int(getattr(signal, name))
+    for name in ("SIGSEGV", "SIGABRT", "SIGBUS", "SIGILL", "SIGFPE")
+    if hasattr(signal, name)
+}
+
+
+# -- forensics ---------------------------------------------------------------
+
+
+def read_events_tail(path: str, max_bytes: int = 1 << 16) -> list[dict]:
+    """Parse the last `max_bytes` of an events.jsonl (torn first/last lines
+    skipped — the file may have died mid-flush with its writer)."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if size > max_bytes:
+                f.seek(size - max_bytes)
+                f.readline()  # drop the (likely) partial first line
+            raw = f.read()
+    except OSError:
+        return []
+    records = []
+    for line in raw.decode("utf-8", errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+    return records
+
+
+def tail_rss_bytes(records: list[dict]) -> float:
+    """Last host-RSS sample in a record tail (0.0 when none): the OOM
+    forensic — a SIGKILL that follows samples near the host's memory is the
+    kernel's OOM killer, not a preemption."""
+    for rec in reversed(records):
+        if rec.get("kind") in ("step", "pod"):
+            rss = rec.get("host_rss_bytes", rec.get("host_rss_bytes_max"))
+            if rss is not None:
+                try:
+                    return float(rss)
+                except (TypeError, ValueError):
+                    return 0.0
+    return 0.0
+
+
+def read_heartbeat(path: str) -> dict | None:
+    """Parse heartbeat.json; None when absent/torn (the write is atomic, so
+    torn means no heartbeat was ever completed)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def classify_exit(
+    returncode: int,
+    *,
+    hang_killed: bool = False,
+    events_tail: list[dict] | None = None,
+    oom_rss_bytes: float = 0.0,
+) -> tuple[str, str]:
+    """(failure class, human-readable detail) for one child death.
+
+    `hang_killed`: the supervisor itself ended this child for heartbeat
+    staleness — that classification wins over the exit code, because a
+    SIGTERM-responsive child exits EXIT_PREEMPTED on the way down and would
+    otherwise masquerade as an ordinary preemption."""
+    if hang_killed:
+        return CLASS_HANG, (
+            f"killed by supervisor for heartbeat staleness (exited "
+            f"{returncode})"
+        )
+    named = {
+        EXIT_OK: CLASS_CLEAN,
+        EXIT_PREEMPTED: CLASS_PREEMPTED,
+        EXIT_ROLLBACK_EXHAUSTED: CLASS_ROLLBACK_EXHAUSTED,
+        EXIT_CONFIG_ERROR: CLASS_CONFIG_ERROR,
+        EXIT_DATA_QUALITY: CLASS_DATA_QUALITY,
+        USAGE_ERROR: CLASS_CONFIG_ERROR,
+    }
+    if returncode in named:
+        return named[returncode], (
+            f"exit {returncode} ({EXIT_CODE_NAMES.get(returncode, '?')})"
+        )
+    if returncode < 0:
+        sig = -returncode
+        try:
+            signame = signal.Signals(sig).name
+        except ValueError:
+            signame = f"signal {sig}"
+        if sig in _CRASH_SIGNALS:
+            return CLASS_NATIVE_CRASH, (
+                f"died on {signame}: native crash (staging loader / XLA "
+                "runtime)"
+            )
+        if sig == int(signal.SIGKILL):
+            rss = tail_rss_bytes(events_tail or [])
+            if oom_rss_bytes > 0 and rss >= oom_rss_bytes:
+                return CLASS_OOM, (
+                    f"SIGKILL with tail RSS {rss / 2**30:.2f} GiB >= the "
+                    f"{oom_rss_bytes / 2**30:.2f} GiB OOM threshold"
+                )
+            return CLASS_KILLED, (
+                "SIGKILL from outside (hard preemption or OOM killer; tail "
+                f"RSS {rss / 2**30:.2f} GiB)"
+            )
+        return CLASS_KILLED, f"died on external {signame}"
+    return CLASS_CRASH, f"unrecognized exit {returncode} (python traceback?)"
+
+
+# -- resume-integrity preflight ---------------------------------------------
+
+
+def preflight_resume(ckpt_dir: str, emit=None) -> list[int]:
+    """Quarantine every checkpoint step that fails its integrity manifest
+    BEFORE relaunching the child, so `--resume auto` never even sees a
+    corrupt emergency checkpoint. (The child's own restore walks back past
+    corrupt steps too — but a restore crash inside a freshly-launched
+    child costs a whole restart out of the budget; here it costs a rename.)
+
+    Newest-first, stopping at the first step that verifies: `--resume
+    auto` only ever restores the newest surviving candidate, so hashing
+    the older steps too would add minutes of sha256 I/O (multi-GB states ×
+    max_to_keep) to every relaunch — including the no-backoff preemption
+    relaunches that are supposed to be immediate. A corrupt step BEHIND a
+    verifying one is unreachable except through the child's own
+    restore-time walk-back, which re-verifies per candidate anyway.
+
+    Steps are moved to `<ckpt_dir>/.quarantine/<step>` (dot-prefixed:
+    invisible to Orbax's step listing) with their sidecars; manifest-less
+    steps are left alone — pre-manifest checkpoints stay restorable, the
+    restore itself is then the gate. Returns the quarantined step numbers."""
+    from moco_tpu.resilience.integrity import (
+        manifest_path,
+        position_path,
+        verify_step,
+    )
+
+    quarantined: list[int] = []
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return quarantined
+    for name in sorted((n for n in names if n.isdigit()), key=int,
+                       reverse=True):
+        step = int(name)
+        reason = verify_step(ckpt_dir, step)
+        if reason is None:
+            break  # newest surviving candidate: the only one resume reads
+        qdir = os.path.join(ckpt_dir, QUARANTINE_DIRNAME)
+        os.makedirs(qdir, exist_ok=True)
+        target = os.path.join(qdir, name)
+        if os.path.exists(target):  # quarantined twice across restarts
+            target = os.path.join(qdir, f"{name}.{int(time.time())}")
+        os.rename(os.path.join(ckpt_dir, name), target)
+        for sidecar in (
+            manifest_path(ckpt_dir, step),
+            position_path(ckpt_dir, step),
+        ):
+            try:
+                os.remove(sidecar)
+            except OSError:
+                pass  # sidecar absent (pre-position checkpoint) — fine
+        quarantined.append(step)
+        if emit is not None:
+            emit("preflight_quarantine", step=step, reason=reason,
+                 moved_to=target)
+        log_event(
+            "supervisor",
+            f"preflight: quarantined corrupt checkpoint step {step} "
+            f"({reason}) -> {target}",
+        )
+    return quarantined
+
+
+# -- policy ------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RestartPolicy:
+    """Per-class restart policy knobs (tools/supervise.py exposes each)."""
+
+    max_restarts: int = 5             # consecutive no-progress restarts
+                                      # before giving up; any step progress
+                                      # refunds the full budget
+    backoff_base_secs: float = 1.0    # exponential backoff base ...
+    backoff_max_secs: float = 60.0    # ... capped here ...
+    backoff_jitter: float = 0.2       # ... times (1 + U[0, jitter]) so a
+                                      # pod of supervisors doesn't relaunch
+                                      # in lockstep
+    heartbeat_stale_secs: float = 120.0  # kill the child when its newest
+                                      # step-phase beat is older than this.
+                                      # <= 0 disables hang detection
+                                      # entirely (exit classification and
+                                      # restarts still run) — REQUIRED for
+                                      # supervisors of non-main pod hosts,
+                                      # which never write a heartbeat
+                                      # (telemetry is process-0-only) and
+                                      # would otherwise be killed as
+                                      # "hung" on a cycle
+    startup_grace_secs: float = 900.0  # staleness allowance before the
+                                      # first step-phase beat of each
+                                      # launch (cold XLA compile + restore
+                                      # legitimately produce no steps)
+    term_grace_secs: float = 30.0     # SIGTERM -> this grace -> SIGKILL
+    poll_secs: float = 2.0            # supervisor wake-up cadence
+    oom_rss_bytes: float = 0.0        # classify SIGKILL as OOM when the
+                                      # events tail shows RSS >= this (0 =
+                                      # never; there is no portable way to
+                                      # read the cgroup limit from here)
+    restart_on: frozenset = RESTARTABLE_CLASSES
+    no_backoff: frozenset = frozenset({CLASS_PREEMPTED})
+                                      # a preempted VM that came back is
+                                      # healthy — relaunch immediately
+
+    def backoff_secs(self, consecutive_failures: int, rng: random.Random) -> float:
+        """Exponential in the number of consecutive no-progress failures,
+        capped, with multiplicative jitter."""
+        base = min(
+            self.backoff_base_secs * (2.0 ** max(consecutive_failures - 1, 0)),
+            self.backoff_max_secs,
+        )
+        return base * (1.0 + self.backoff_jitter * rng.random())
+
+
+@dataclasses.dataclass
+class SupervisorResult:
+    final_class: str
+    exit_code: int | None
+    launches: int               # total child launches (restarts + 1)
+    restarts: int
+    gave_up: bool               # budget exhausted with the run unfinished
+    classifications: list[str]  # one per child death, in order
+
+
+class Supervisor:
+    """Run `child_argv` under supervision until it finishes or the policy
+    gives up. `telemetry_dir` must match the child's `--telemetry-dir`
+    (heartbeat + events live there); `ckpt_dir` (the child's `--ckpt-dir`)
+    enables the resume preflight and the checkpoint-step progress fallback.
+
+    On every launch (the first included — a restarted supervisor over an
+    existing ckpt_dir must continue the run, not retrain from step 0
+    underneath it) `--resume auto` is appended to the child argv unless
+    the caller already passed a `--resume` (`force_resume=False` disables
+    this) — a supervisor that restarts from scratch would be a very slow
+    crash loop."""
+
+    def __init__(
+        self,
+        child_argv: list[str],
+        *,
+        telemetry_dir: str,
+        ckpt_dir: str = "",
+        policy: RestartPolicy | None = None,
+        env: dict | None = None,
+        force_resume: bool = True,
+        child_log_path: str = "",
+        seed: int | None = None,
+        time_fn=time.monotonic,
+    ):
+        self.child_argv = list(child_argv)
+        self.telemetry_dir = telemetry_dir
+        self.ckpt_dir = ckpt_dir
+        self.policy = policy or RestartPolicy()
+        self.env = env
+        self.force_resume = force_resume
+        self.child_log_path = child_log_path or os.path.join(
+            telemetry_dir, "child.log"
+        )
+        self.events_path = os.path.join(telemetry_dir, EVENTS_FILENAME)
+        self.heartbeat_path = os.path.join(telemetry_dir, HEARTBEAT_FILENAME)
+        self.incidents: list[dict] = []  # in-memory mirror of emitted records
+        # seed=None (the CLI default) draws system entropy: a fleet of
+        # supervisors hit by one pod-wide fault must NOT share a jitter
+        # stream, or they relaunch in lockstep — the stampede the jitter
+        # exists to prevent. Tests pass an explicit seed for determinism.
+        self._rng = random.Random(seed)
+        self._now = time_fn
+        self._budget = self.policy.max_restarts
+        self._consecutive_failures = 0
+        self._ever_beat = False  # any beat in any launch: distinguishes a
+                                 # wedged child from a missing heartbeat
+                                 # channel (telemetry off / wrong dir)
+
+    # -- structured incidents (same stream the child writes) ----------------
+    def _emit(self, event: str, **fields) -> None:
+        record = {"v": 1, "t": round(time.time(), 3), "kind": "supervisor",
+                  "event": event}
+        record.update(fields)
+        self.incidents.append(record)
+        os.makedirs(self.telemetry_dir, exist_ok=True)
+        # O_APPEND one-line writes: safe to interleave with the child's own
+        # appends (the child is usually dead when the supervisor writes; a
+        # concurrent kill record lands on its own line either way)
+        with open(self.events_path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(record) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        detail = " ".join(f"{k}={v}" for k, v in fields.items())
+        log_event("supervisor", f"{event} {detail}".strip())
+
+    # -- progress (heartbeat + checkpoint sidecar fallback) -----------------
+    def _progress_marker(self) -> int:
+        """Newest known completed step: the heartbeat's (whoever wrote it —
+        across a death it is the dead child's last word), else the newest
+        on-disk checkpoint step. -1 when nothing has ever progressed."""
+        marker = -1
+        hb = read_heartbeat(self.heartbeat_path)
+        if hb is not None:
+            try:
+                marker = max(marker, int(hb.get("step", -1)))
+            except (TypeError, ValueError):
+                pass  # foreign heartbeat shape: fall through to checkpoints
+        if self.ckpt_dir:
+            try:
+                for name in os.listdir(self.ckpt_dir):
+                    if name.isdigit():
+                        marker = max(marker, int(name))
+            except OSError:
+                pass  # no checkpoint dir yet
+        return marker
+
+    # -- budget (crash-loop detection) --------------------------------------
+    def _note_exit(self, progressed: bool) -> bool:
+        """Update the restart budget after a restartable death; True when a
+        restart is still allowed. Progress refunds the FULL budget and its
+        restart is free: only consecutive no-progress deaths count toward
+        the crash-loop limit, so a multi-day run that keeps advancing
+        restarts indefinitely."""
+        if progressed:
+            self._budget = self.policy.max_restarts
+            self._consecutive_failures = 0
+            return self._budget > 0
+        self._consecutive_failures += 1
+        if self._budget <= 0:
+            return False
+        self._budget -= 1
+        return True
+
+    # -- child lifecycle -----------------------------------------------------
+    def _launch(self, attempt: int) -> subprocess.Popen:
+        argv = list(self.child_argv)
+        has_resume = any(
+            a == "--resume" or a.startswith("--resume=")
+            for a in self.child_argv
+        )
+        if self.force_resume and not has_resume:
+            # EVERY launch, attempt 0 included: a restarted SUPERVISOR
+            # (host reboot, cron) over an existing ckpt_dir must continue
+            # the run, not retrain from step 0 underneath it — and on an
+            # empty directory `--resume auto` restores nothing, so this is
+            # strictly safe
+            argv += ["--resume", "auto"]
+        # the supervisor usually starts BEFORE the child ever creates the
+        # telemetry dir — the log (and the first incident record) must not
+        # depend on the child having run
+        os.makedirs(os.path.dirname(self.child_log_path) or ".", exist_ok=True)
+        log_file = open(self.child_log_path, "ab")
+        try:
+            child = subprocess.Popen(
+                argv, stdout=log_file, stderr=subprocess.STDOUT, env=self.env
+            )
+        finally:
+            # the child holds its own descriptor; keeping ours open would
+            # leak one fd per restart for the supervisor's lifetime
+            log_file.close()
+        self._emit("launch", attempt=attempt, pid=child.pid,
+                   budget_left=self._budget, argv=argv)
+        return child
+
+    def _kill_for_hang(self, child: subprocess.Popen, stale_for: float) -> None:
+        self._emit("kill", pid=child.pid, reason="heartbeat_stale",
+                   stale_secs=round(stale_for, 3), phase="sigterm")
+        child.send_signal(signal.SIGTERM)
+        deadline = self._now() + self.policy.term_grace_secs
+        while child.poll() is None and self._now() < deadline:
+            time.sleep(min(self.policy.poll_secs, 0.2))
+        if child.poll() is None:
+            self._emit("kill", pid=child.pid, reason="heartbeat_stale",
+                       phase="sigkill")
+            child.kill()
+            child.wait()
+
+    def _monitor(self, child: subprocess.Popen) -> bool:
+        """Block until the child exits; True when the supervisor killed it
+        for heartbeat staleness. The tight staleness window only applies
+        while the newest beat from THIS child has phase "step" — during
+        startup (jax import, XLA compile, restore) and every other
+        declared phase (an "eval" beat before a multi-minute kNN eval, the
+        "run_end"/"preempt_exit" beat before finalize/export) silence is
+        normal and only the generous startup grace applies. A supervisor
+        that NEVER sees a beat in any launch (telemetry off, mismatched
+        --telemetry-dir) disables hang detection with a loud incident
+        instead of kill-looping a healthy child forever."""
+        launched = self._now()
+        launched_wall = time.time()
+        beat_phase = None     # phase of the newest beat from this child
+        last_beat = launched  # supervisor-clock time of the newest beat
+        last_t = None         # the beat's own wall-clock stamp
+        warned_pid = False
+        hang_detection = self.policy.heartbeat_stale_secs > 0
+        while child.poll() is None:
+            time.sleep(self.policy.poll_secs)
+            if not hang_detection:
+                continue  # non-main pod hosts: no heartbeat ever exists
+            hb = read_heartbeat(self.heartbeat_path)
+            if hb is not None:
+                # a beat counts when its pid is our direct child, OR when
+                # it is fresher than this launch — the trainer may be a
+                # grandchild behind a wrapper (srun, bash -c, docker run),
+                # whose pid never equals Popen's. The freshness bound
+                # keeps a STALE file from the previous incarnation from
+                # arming the tight window during this child's compile.
+                mine = hb.get("pid") == child.pid
+                fresh = isinstance(hb.get("t"), (int, float)) and \
+                    hb["t"] > launched_wall
+                if (mine or fresh) and hb.get("t") != last_t:
+                    last_t = hb.get("t")
+                    last_beat = self._now()
+                    beat_phase = hb.get("phase")
+                    self._ever_beat = True
+                    if fresh and not mine and not warned_pid:
+                        warned_pid = True
+                        self._emit(
+                            "heartbeat_pid_mismatch", child_pid=child.pid,
+                            beat_pid=hb.get("pid"),
+                            note="wrapper command? beats accepted by "
+                                 "freshness; progress checks unaffected",
+                        )
+            window = (self.policy.heartbeat_stale_secs
+                      if beat_phase == "step"
+                      else self.policy.startup_grace_secs)
+            stale_for = self._now() - last_beat
+            if stale_for > window:
+                if last_t is None and not self._ever_beat:
+                    # no beat EVER, in this or any previous launch: the
+                    # heartbeat channel itself is missing (telemetry off,
+                    # mismatched --telemetry-dir) — killing a child that
+                    # never promised beats would loop forever, each kill
+                    # refunded by checkpoint progress
+                    self._emit(
+                        "no_heartbeat", child_pid=child.pid,
+                        heartbeat_path=self.heartbeat_path,
+                        note="no heartbeat observed in any launch — hang "
+                             "detection DISABLED; is --telemetry-dir the "
+                             "child's telemetry dir, and telemetry on?",
+                    )
+                    hang_detection = False
+                    continue
+                self._kill_for_hang(child, stale_for)
+                return True
+        return False
+
+    # -- main loop -----------------------------------------------------------
+    def run(self) -> SupervisorResult:
+        attempt = 0
+        classifications: list[str] = []
+        marker_before = self._progress_marker()
+        while True:
+            if self.ckpt_dir and attempt > 0:
+                preflight_resume(self.ckpt_dir, emit=self._emit)
+            child = self._launch(attempt)
+            hang_killed = self._monitor(child)
+            rc = child.returncode
+            cls, detail = classify_exit(
+                rc,
+                hang_killed=hang_killed,
+                events_tail=read_events_tail(self.events_path),
+                oom_rss_bytes=self.policy.oom_rss_bytes,
+            )
+            marker_now = self._progress_marker()
+            progressed = marker_now > marker_before
+            marker_before = max(marker_before, marker_now)
+            classifications.append(cls)
+            self._emit("exit", pid=child.pid, returncode=rc,
+                       classification=cls, detail=detail,
+                       progressed=progressed, last_step=marker_now)
+            if cls == CLASS_CLEAN:
+                self._emit("done", launches=attempt + 1, restarts=attempt)
+                return SupervisorResult(cls, rc, attempt + 1, attempt,
+                                        False, classifications)
+            if cls not in self.policy.restart_on:
+                self._emit("give_up", reason=f"fatal class {cls}",
+                           returncode=rc, restarts=attempt)
+                return SupervisorResult(cls, rc, attempt + 1, attempt,
+                                        False, classifications)
+            if not self._note_exit(progressed):
+                self._emit(
+                    "give_up",
+                    reason=(
+                        f"restart budget exhausted: "
+                        f"{self._consecutive_failures} consecutive "
+                        f"no-progress deaths (max_restarts="
+                        f"{self.policy.max_restarts})"
+                    ),
+                    returncode=rc, restarts=attempt,
+                )
+                return SupervisorResult(cls, rc, attempt + 1, attempt,
+                                        True, classifications)
+            if cls not in self.policy.no_backoff:
+                delay = self.policy.backoff_secs(
+                    self._consecutive_failures, self._rng
+                )
+                self._emit("backoff", secs=round(delay, 3),
+                           consecutive_failures=self._consecutive_failures,
+                           budget_left=self._budget)
+                time.sleep(delay)
+            attempt += 1
+            self._emit("restart", attempt=attempt, after=cls,
+                       budget_left=self._budget)

@@ -6,8 +6,12 @@ exception fires, and a multi-day run burns quota doing nothing. The
 watchdog is a background thread that flags — loudly, and again every
 further interval — when no `beat()` has arrived within the configured
 window. It deliberately only FLAGS (via `log_event`): killing the process
-from a watchdog thread would turn a transient stall into data loss; the
-operator (or the surrounding orchestration reading the log) decides.
+from a watchdog thread would turn a transient stall into data loss. The
+KILL decision belongs to the out-of-process supervisor
+(resilience/supervisor.py), which watches the same silence through
+heartbeat.json staleness and escalates SIGTERM → grace → SIGKILL →
+classified restart; this in-process flag remains the operator's early
+warning and the telemetry stream's record of the stall.
 """
 
 from __future__ import annotations

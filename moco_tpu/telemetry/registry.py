@@ -240,14 +240,28 @@ class MetricsRegistry:
 
 
 class Heartbeat:
-    """Atomically-replaced liveness file for external watchdogs.
+    """Atomically-replaced liveness file for the run supervisor (ISSUE 4)
+    and any other external watchdog.
 
     Monitors `stat` the file: a stale mtime (or a stale "t" inside) means
     the run stopped making progress even if the process is still alive.
-    Atomic replace, never append — a reader must never see a torn write."""
+    Atomic replace, never append — a reader must never see a torn write.
 
-    def __init__(self, path: str):
+    The payload carries everything the supervisor's hang/progress checks
+    need without log scraping: `pid` (is this beat from MY child, or a
+    stale file from the previous incarnation?), `step` (monotonic progress
+    for the restart-budget refund), and `phase` ("run_start" before the
+    first step — cold-compile stalls there are normal — "step" once the
+    loop is actually advancing, "run_end"/"preempt_exit" at the exits).
+
+    `min_interval_secs` gates `maybe_beat` (the every-step call): one
+    atomic replace per second is free, one per 100 ms step is not. `beat`
+    always writes (lifecycle transitions must never be elided)."""
+
+    def __init__(self, path: str, min_interval_secs: float = 0.0):
         self.path = path
+        self.min_interval = float(min_interval_secs)
+        self._last_write = float("-inf")
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
     def beat(self, step: int, **fields) -> None:
@@ -258,3 +272,14 @@ class Heartbeat:
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(payload, f)
         os.replace(tmp, self.path)
+        self._last_write = time.monotonic()
+
+    def maybe_beat(self, step: int, **fields) -> bool:
+        """Time-gated beat for per-step call sites: writes (and returns
+        True) only when `min_interval_secs` has elapsed since the last
+        write — the supervisor's staleness granularity is the max of this
+        and the step time, independent of any flush cadence."""
+        if time.monotonic() - self._last_write < self.min_interval:
+            return False
+        self.beat(step, **fields)
+        return True

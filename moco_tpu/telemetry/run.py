@@ -50,7 +50,8 @@ class RunTelemetry:
             flush_every=config.telemetry_flush_steps,
         )
         self.heartbeat = (
-            Heartbeat(os.path.join(run_dir, HEARTBEAT_FILENAME))
+            Heartbeat(os.path.join(run_dir, HEARTBEAT_FILENAME),
+                      min_interval_secs=getattr(config, "heartbeat_secs", 1.0))
             if is_main else None
         )
         self.timer = StepPhaseTimer(stride=config.telemetry_stride)
@@ -99,6 +100,16 @@ class RunTelemetry:
         """Structured non-incident event (e.g. knn_eval, epoch_summary)."""
         self.registry.emit("event", event=kind, **fields)
 
+    def phase_beat(self, phase: str, step: int) -> None:
+        """Forced heartbeat declaring a known-long non-step phase (the
+        epoch-boundary kNN eval): the supervisor widens its staleness
+        window to the startup grace while the newest beat's phase is not
+        "step" — the out-of-process analogue of StepWatchdog.suspended()
+        (a multi-minute eval with no step beats would otherwise be killed
+        as a hang)."""
+        if self.heartbeat is not None:
+            self.heartbeat.beat(step, phase=phase)
+
     # -- per-step ------------------------------------------------------------
     def on_step(self, step: int, phases: dict, throughput, loss=None) -> bool:
         """Emit one step record; returns True when this step flushed the
@@ -132,8 +143,13 @@ class RunTelemetry:
             imgs_per_sec=rolling, incidents=self._incidents.value,
         )
         flushed = self.registry.emit("step", **record)
-        if flushed and self.heartbeat is not None:
-            self.heartbeat.beat(step)
+        if self.heartbeat is not None:
+            # EVERY step, decoupled from the sink's flush cadence (ISSUE 4
+            # satellite): hang-detection granularity used to be an accident
+            # of telemetry_flush_steps — a 50-step flush cadence meant the
+            # supervisor saw a "hang" of 50 step times. The time gate
+            # (heartbeat_secs) keeps the atomic replace off the fast path.
+            self.heartbeat.maybe_beat(step, phase="step")
         return flushed
 
     # -- pod sync (piggybacks on the resilience_sync_steps allgather) --------
@@ -171,6 +187,12 @@ class RunTelemetry:
         summary.update(extra_summary)
         self.registry.emit("run_end", **summary)
         if self.heartbeat is not None:
-            self.heartbeat.beat(summary.get("last_step", self._step_hist.count),
-                                phase="run_end")
+            # the final heartbeat is the supervisor's progress record for
+            # the restart-budget refund: last completed step + this pid,
+            # phase distinguishing a preemption exit (relaunch expected)
+            # from a natural end
+            self.heartbeat.beat(
+                summary.get("last_step", self._step_hist.count),
+                phase="preempt_exit" if summary.get("preempted") else "run_end",
+            )
         self.registry.close()

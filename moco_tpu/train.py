@@ -17,6 +17,7 @@ import argparse
 import contextlib
 import json
 import os
+import sys
 import time
 
 import jax
@@ -205,7 +206,14 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
     installed_chaos = False
     if config.chaos:
         if active_chaos() is None:
-            install_chaos(parse_chaos_spec(config.chaos))
+            plan = parse_chaos_spec(config.chaos)
+            if plan is not None:
+                # same cross-restart fire-once persistence as env-installed
+                # plans: a supervised drill restarts the process, and a
+                # --chaos kill/freeze re-firing on every re-traversal would
+                # crash-loop the drill
+                plan.state_dir = os.environ.get("MOCO_TPU_CHAOS_STATE") or None
+            install_chaos(plan)
             installed_chaos = True
         else:
             # an already-active plan (chaos_context in tests, or a
@@ -668,6 +676,13 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                             writer.flush()
                     if plan is not None:
                         plan.maybe_sigterm(global_step)
+                        # process-level faults (ISSUE 4): SIGKILL-grade death
+                        # and wedged-collective freeze — both invisible to
+                        # the in-process handlers, recoverable only by the
+                        # out-of-process supervisor. After on_step, so the
+                        # heartbeat's last beat records this step.
+                        plan.maybe_kill(global_step)
+                        plan.maybe_freeze(global_step)
                     if preempt_agreed or (n_procs == 1 and preempt.triggered):
                         # finish-the-step-then-exit: the emergency checkpoint
                         # (a COLLECTIVE save) lands after the loop, at a step
@@ -717,6 +732,11 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                 or epoch == config.epochs - 1
                 or done
             ):
+                if telemetry is not None:
+                    # the supervisor's analogue of watchdog.suspended():
+                    # an "eval" beat widens its staleness window so the
+                    # beat-less minutes below aren't killed as a hang
+                    telemetry.phase_beat("eval", global_step)
                 with watchdog.suspended():
                     # a multi-minute eval with no step beats is a guaranteed
                     # false 'possible hang' flag otherwise
@@ -761,8 +781,11 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
         if telemetry is not None:
             # run_end summary + final flush; also surfaces the writer's
             # dropped-scalar count (ISSUE 2 satellite) so silent drops are
-            # visible in the machine record
-            telemetry.close(scalar_drops=writer.dropped, last_step=global_step)
+            # visible in the machine record. `preempted` routes the final
+            # heartbeat's phase (preempt_exit vs run_end) so the supervisor
+            # knows a relaunch is expected without scraping logs.
+            telemetry.close(scalar_drops=writer.dropped, last_step=global_step,
+                            preempted=preempted)
         writer.close()
         if mgr is not None:
             # commit any in-flight async epoch save (and its deferred
@@ -780,8 +803,14 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
             "preempt",
             f"writing emergency checkpoint at step {global_step}, then "
             "exiting cleanly",
+            step=global_step, pid=os.getpid(),
         )
         save_checkpoint(mgr, state, global_step, position=emergency_pos)
+    if preempted:
+        # surfaced to callers (absent otherwise): main() turns it into
+        # EXIT_PREEMPTED so the supervisor can tell a preemption's clean
+        # exit (“relaunch me”) from a natural end without log forensics
+        last_metrics = dict(last_metrics, preempted=True)
     if mgr is not None:
         finalize_checkpoints(mgr)
     if config.export_path and is_main and not preempted:
@@ -805,7 +834,20 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
 
 
 def main(argv=None):
+    """CLI entry. Exits through the named codes in resilience/exitcodes.py
+    (the supervisor's classification protocol — lint rule R5 forbids bare
+    `sys.exit(<int>)` here): 0 clean, EXIT_PREEMPTED after an honored
+    SIGTERM + emergency checkpoint, EXIT_ROLLBACK_EXHAUSTED /
+    EXIT_DATA_QUALITY for the deliberate run-enders a restart cannot fix,
+    EXIT_CONFIG_ERROR for a bad preset/flag. Anything else propagates as a
+    traceback (python's exit 1 → classified as a generic crash)."""
     from moco_tpu.config import add_config_flags, collect_overrides
+    from moco_tpu.resilience.exitcodes import (
+        EXIT_CONFIG_ERROR,
+        EXIT_DATA_QUALITY,
+        EXIT_PREEMPTED,
+        EXIT_ROLLBACK_EXHAUSTED,
+    )
 
     parser = argparse.ArgumentParser(description="moco_tpu pretraining")
     pretrain_presets = sorted(
@@ -832,9 +874,15 @@ def main(argv=None):
         from moco_tpu.parallel.mesh import distributed_init
 
         distributed_init(args.coordinator_address, args.num_processes, args.process_id)
-    config = get_preset(args.preset).replace(
-        **collect_overrides(args, PretrainConfig)
-    )
+    try:
+        config = get_preset(args.preset).replace(
+            **collect_overrides(args, PretrainConfig)
+        )
+    except (TypeError, ValueError) as e:
+        # bad flag value / preset / __post_init__ validation: the same argv
+        # can never succeed, so the exit code must say "don't restart me"
+        log_event("exit", f"config error: {e}", code=EXIT_CONFIG_ERROR)
+        sys.exit(EXIT_CONFIG_ERROR)
     # persistent XLA compile cache: a restarted/resumed run (or the bench
     # re-running this config) skips the multi-minute cold compile
     from moco_tpu.utils.cache import enable_persistent_cache
@@ -843,7 +891,19 @@ def main(argv=None):
     mesh = create_mesh(args.num_devices)
     info(f"config: {config}")
     info(f"mesh: {mesh}")
-    train(config, mesh, max_steps=args.max_steps)
+    try:
+        _state, metrics = train(config, mesh, max_steps=args.max_steps)
+    except RollbackExhaustedError as e:
+        log_event("exit", f"rollback budget exhausted: {e}",
+                  code=EXIT_ROLLBACK_EXHAUSTED)
+        sys.exit(EXIT_ROLLBACK_EXHAUSTED)
+    except DataQualityError as e:
+        log_event("exit", f"data quality abort: {e}", code=EXIT_DATA_QUALITY)
+        sys.exit(EXIT_DATA_QUALITY)
+    if metrics.get("preempted"):
+        log_event("exit", "preemption honored: emergency checkpoint written, "
+                          "exiting for relaunch", code=EXIT_PREEMPTED)
+        sys.exit(EXIT_PREEMPTED)
 
 
 if __name__ == "__main__":

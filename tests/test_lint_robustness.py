@@ -128,6 +128,34 @@ def test_r4_close_in_wrong_scope_still_flagged(tmp_path):
     assert len(found) == 1 and ":2:" in found[0]
 
 
+def test_r5_detects_numeric_literal_exits(tmp_path):
+    """R5 (ISSUE 4): a magic-number process exit silently forks the
+    supervisor's classification protocol — every flavor is flagged."""
+    (tmp_path / "exits.py").write_text(
+        "import os, sys\n"
+        "sys.exit(43)\n"                      # the core violation
+        "os._exit(1)\n"
+        "raise SystemExit(3)\n"
+    )
+    found = lint.check_file(str(tmp_path / "exits.py"))
+    assert len(found) == 3
+    assert all("named constants" in v for v in found)
+
+
+def test_r5_accepts_named_constants_and_bare_exits(tmp_path):
+    (tmp_path / "ok.py").write_text(
+        "import sys\n"
+        "from moco_tpu.resilience.exitcodes import EXIT_PREEMPTED\n"
+        "sys.exit(EXIT_PREEMPTED)\n"          # the protocol
+        "sys.exit()\n"                        # bare: plain success
+        "sys.exit('message')\n"               # message form: not a code
+        "raise SystemExit(EXIT_PREEMPTED)\n"
+        "parser.exit(2)\n"                    # argparse's API, not ours
+        "pool.exit(0)\n"                      # any method named exit
+    )
+    assert lint.check_file(str(tmp_path / "ok.py")) == []
+
+
 def test_r4_holds_for_bench_and_package_call_sites():
     """The real construction sites (train driver, lincls, bench.py — the
     latter outside the package tree, held to R4 here) stay clean."""

@@ -181,6 +181,30 @@ def test_cli_chaos_plan_cleared_after_train(mesh8, tmp_path):
 
 
 @pytest.mark.chaos
+def test_cli_chaos_plan_gets_state_dir_from_env(mesh8, tmp_path, monkeypatch):
+    """A --chaos plan under a supervisor must persist fire-once state via
+    MOCO_TPU_CHAOS_STATE exactly like an env-installed plan — otherwise a
+    supervised kill/freeze drill re-fires on every restart and crash-loops
+    (ISSUE 4). Captured at clear time: the plan is scoped to train()."""
+    import moco_tpu.train as train_mod
+    from moco_tpu.resilience import active_chaos
+
+    captured = {}
+    real_clear = train_mod.clear_chaos
+
+    def spy_clear():
+        captured["plan"] = active_chaos()
+        real_clear()
+
+    monkeypatch.setattr(train_mod, "clear_chaos", spy_clear)
+    monkeypatch.setenv("MOCO_TPU_CHAOS_STATE", str(tmp_path / "markers"))
+    cfg = micro_config(tmp_path, ckpt_dir="", epochs=1, chaos="nan_at_step=99")
+    train(cfg, mesh8)
+    assert captured["plan"].state_dir == str(tmp_path / "markers")
+    assert active_chaos() is None
+
+
+@pytest.mark.chaos
 def test_resume_after_rollback_drift_is_bitidentical(mesh8, tmp_path):
     """A NaN rollback's data-window skip permanently drifts the step↔batch
     mapping, so a LATER preemption must resume from the checkpoint's
@@ -415,6 +439,29 @@ def test_preemption_flag_and_second_signal_chains():
     assert signal.getsignal(signal.SIGINT) is before
 
 
+def test_preemption_second_signal_chains_to_callable_handler():
+    """Second-signal chaining with a CALLABLE previous disposition (a
+    custom handler, not python's default): the handler must be invoked
+    directly — re-raising through signal.signal would lose it (ISSUE 4
+    satellite: this branch was previously pinned only indirectly)."""
+    calls = []
+
+    def custom(signum, frame):
+        calls.append(signum)
+
+    before = signal.signal(signal.SIGTERM, custom)
+    try:
+        with PreemptionHandler(signums=(signal.SIGTERM,)) as h:
+            signal.raise_signal(signal.SIGTERM)
+            assert h.triggered and not calls  # first: flag only
+            signal.raise_signal(signal.SIGTERM)
+            assert calls == [signal.SIGTERM]  # second: chained to custom
+        # exit restores the pre-handler disposition, not SIG_DFL
+        assert signal.getsignal(signal.SIGTERM) is custom
+    finally:
+        signal.signal(signal.SIGTERM, before)
+
+
 def test_preemption_inert_off_main_thread():
     out = {}
 
@@ -468,6 +515,38 @@ def test_watchdog_flags_stall_and_rearms_on_beat():
         time.sleep(0.02)
         assert w.stalls == seen  # beat re-armed the window
     assert w._thread is None
+
+
+def test_watchdog_rearm_spacing_one_flag_per_interval():
+    """During CONTINUED silence the watchdog flags once per further full
+    interval, not once per poll — the re-arm threshold ratchets (ISSUE 4
+    satellite: the ratchet was previously untested)."""
+    with StepWatchdog(0.2) as w:
+        time.sleep(0.3)   # one interval elapsed: exactly one flag
+        assert w.stalls == 1
+        time.sleep(0.1)   # still within the second interval window
+        assert w.stalls == 1
+        time.sleep(0.2)   # second full interval of silence: second flag
+        assert w.stalls == 2
+        w.beat(9)         # beat resets the ratchet to ONE interval again
+        time.sleep(0.3)
+        assert w.stalls == 3
+
+
+def test_watchdog_nested_suspended_scopes():
+    """suspended() nests: the inner exit must NOT un-suspend the outer
+    scope (an epoch-boundary eval that itself wraps a blocking save), and
+    the watchdog re-arms fresh only when the outermost scope exits."""
+    with StepWatchdog(0.05) as w:
+        with w.suspended():
+            with w.suspended():
+                time.sleep(0.15)
+            assert w._suspend == 1   # inner exit: still suspended
+            time.sleep(0.15)
+            assert w.stalls == 0     # outer scope still protects
+        assert w._suspend == 0
+        time.sleep(0.3)              # real silence after full exit: flags
+        assert w.stalls >= 1
 
 
 def test_watchdog_disabled_is_inert():

@@ -196,6 +196,72 @@ def test_heartbeat_atomic_and_parseable(tmp_path):
     assert not os.path.exists(hb.path + ".tmp")
 
 
+def test_heartbeat_maybe_beat_time_gated(tmp_path):
+    """maybe_beat honors min_interval_secs (the per-step call must not pay
+    an atomic replace per 100 ms step); beat() always writes (lifecycle
+    transitions are never elided)."""
+    hb = Heartbeat(str(tmp_path / "heartbeat.json"), min_interval_secs=60.0)
+    assert hb.maybe_beat(1, phase="step")      # first write always lands
+    assert not hb.maybe_beat(2, phase="step")  # gated: way inside 60 s
+    with open(hb.path) as f:
+        assert json.load(f)["step"] == 1
+    hb.beat(3, phase="run_end")                # forced lifecycle write
+    with open(hb.path) as f:
+        assert json.load(f)["step"] == 3
+    ungated = Heartbeat(str(tmp_path / "hb2.json"), min_interval_secs=0.0)
+    assert ungated.maybe_beat(1) and ungated.maybe_beat(2)
+
+
+def test_on_step_beats_every_step_decoupled_from_flush(tmp_path, mesh8):
+    """ISSUE 4 satellite: the heartbeat used to advance only when the sink
+    flushed, making hang-detection granularity an accident of
+    telemetry_flush_steps. It now beats every step (time-gated), with the
+    step + phase fields the supervisor's progress check reads."""
+    from moco_tpu.telemetry import RunTelemetry
+
+    config = get_preset("cifar10-moco-v1").replace(
+        telemetry_dir=str(tmp_path), telemetry_flush_steps=10_000,
+        heartbeat_secs=0.0, telemetry_stride=0,
+    )
+    tel = RunTelemetry(config, n_chips=8, n_procs=1, process_index=0,
+                       steps_per_epoch=4)
+    try:
+        thr = Throughput(8, window=4)
+        thr.update(16)
+        phases = {"step_s": 0.01, "data_s": 0.001, "host_s": 0.001}
+        hb_path = os.path.join(str(tmp_path), "heartbeat.json")
+        for step in (1, 2, 3):
+            flushed = tel.on_step(step, dict(phases), thr)
+            assert not flushed  # flush cadence never reached …
+            with open(hb_path) as f:
+                payload = json.load(f)
+            assert payload["step"] == step  # … yet every step beat
+            assert payload["phase"] == "step"
+            assert payload["pid"] == os.getpid()
+    finally:
+        tel.close(last_step=3)
+    with open(hb_path) as f:
+        final = json.load(f)
+    assert final["phase"] == "run_end" and final["step"] == 3
+
+
+def test_close_preempted_marks_heartbeat_phase(tmp_path, mesh8):
+    """The emergency-exit path stamps phase=preempt_exit with the last
+    completed step + pid, so the supervisor can tell 'relaunch me' from a
+    natural end without scraping logs (ISSUE 4 satellite)."""
+    from moco_tpu.telemetry import RunTelemetry
+
+    config = get_preset("cifar10-moco-v1").replace(
+        telemetry_dir=str(tmp_path), heartbeat_secs=0.0)
+    tel = RunTelemetry(config, n_chips=8, n_procs=1, process_index=0,
+                       steps_per_epoch=4)
+    tel.close(last_step=7, preempted=True)
+    with open(os.path.join(str(tmp_path), "heartbeat.json")) as f:
+        payload = json.load(f)
+    assert payload["phase"] == "preempt_exit"
+    assert payload["step"] == 7 and payload["pid"] == os.getpid()
+
+
 # ---------------------------------------------------------------------------
 # MFU / analytic FLOPs
 # ---------------------------------------------------------------------------

@@ -26,6 +26,14 @@ Rules, AST-enforced over every .py file under the package:
       fixed by hand at every call site, now enforced). A construction
       returned directly (`return Prefetcher(...)`) is the factory pattern
       and exempt: the caller owns the close.
+  R5  (ISSUE 4) no numeric-literal process exits — `sys.exit(43)`,
+      `exit(1)`, `os._exit(2)`, `raise SystemExit(3)` — anywhere in the
+      package. Driver exits are the supervisor's classification protocol:
+      they must go through the NAMED constants in
+      resilience/exitcodes.py, so the exit-code table has exactly one
+      source of truth and a renumbering can never silently fork the
+      supervisor from the drivers. (`sys.exit()` bare and
+      `sys.exit(EXIT_PREEMPTED)` are fine.)
 
 Exit 0 when clean; exit 1 with one `path:line: message` per violation.
 Runs in tier-1 via tests/test_lint_robustness.py (which also holds
@@ -46,6 +54,27 @@ PRINT_ALLOWED = ("utils/logging.py", "utils/meters.py")
 
 # R4: constructors whose result owns background staging threads
 LOADER_FACTORIES = {"Prefetcher", "epoch_loader"}
+
+def _is_exit_call(func: ast.expr) -> bool:
+    """Exactly the process-exit spellings: `sys.exit`, `os._exit`, the
+    bare builtins `exit`/`SystemExit`. NOT any method that happens to be
+    named exit (`parser.exit(2)` is argparse's API, not the protocol)."""
+    if isinstance(func, ast.Name):
+        return func.id in ("exit", "SystemExit")
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return (func.value.id == "sys" and func.attr == "exit") or \
+            (func.value.id == "os" and func.attr == "_exit")
+    return False
+
+
+def _r5_violation(node: ast.Call) -> bool:
+    """True for a process-exit call whose first argument is a bare int
+    literal (bool is an int subclass but `sys.exit(True)` is a different
+    bug — still flagged, deliberately)."""
+    if not _is_exit_call(node.func) or not node.args:
+        return False
+    first = node.args[0]
+    return isinstance(first, ast.Constant) and isinstance(first.value, int)
 
 
 def _call_name(node: ast.expr) -> str | None:
@@ -165,6 +194,14 @@ def check_file(path: str) -> list[str]:
     ):
         out.extend(_r4_check(tree, path))
     for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _r5_violation(node):
+            out.append(
+                f"{path}:{node.lineno}: numeric-literal process exit — use "
+                "the named constants in resilience/exitcodes.py (the "
+                "supervisor classifies deaths by these codes; a magic "
+                "number here silently forks the protocol)"
+            )
+            continue
         if (
             not print_allowed
             and isinstance(node, ast.Call)

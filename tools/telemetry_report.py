@@ -14,6 +14,9 @@ Renders, from the schema-versioned record stream the driver writes
   - input pipeline (ISSUE 3): prefetch queue depth, staging-worker busy
     fraction, decode-once cache hit rate, staged-batch latency p50/p95
   - incident counts by event kind (preempt/rollback/chaos/watchdog/...)
+  - supervisor lifecycle (ISSUE 4): launches/restarts/kills, death
+    classifications, final budget state and outcome — the `kind:
+    "supervisor"` records tools/supervise.py appends to the same stream
   - pod-record count and worst cross-host step-time spread
 
 Robustness: unparseable lines (a torn tail from a SIGKILL mid-flush) are
@@ -65,6 +68,7 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
     pods = [r for r in records if r.get("kind") == "pod"]
     run_starts = [r for r in records if r.get("kind") == "run_start"]
     run_ends = [r for r in records if r.get("kind") == "run_end"]
+    supervisor = [r for r in records if r.get("kind") == "supervisor"]
 
     step_s = [r["step_s"] for r in steps if "step_s" in r]
     data_s = [r["data_s"] for r in steps if "data_s" in r]
@@ -149,6 +153,33 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
         ]
         if spreads:
             summary["pod_step_spread_ms_max"] = round(max(spreads) * 1e3, 3)
+    if supervisor:
+        by_event: dict[str, int] = {}
+        for r in supervisor:
+            key = str(r.get("event", "unknown"))
+            by_event[key] = by_event.get(key, 0) + 1
+        exits = [r for r in supervisor if r.get("event") == "exit"]
+        sup: dict = {
+            "events": by_event,
+            "launches": by_event.get("launch", 0),
+            "restarts": by_event.get("restart", 0),
+            # one kill may emit two records (sigterm escalation, then
+            # sigkill); count children killed, not signals sent
+            "kills": sum(1 for r in supervisor if r.get("event") == "kill"
+                         and r.get("phase") != "sigkill"),
+            "classifications": [str(r.get("classification", "?"))
+                                for r in exits],
+        }
+        finals = [r for r in supervisor if r.get("event") in ("done", "give_up")]
+        if finals:
+            last = finals[-1]
+            sup["outcome"] = str(last["event"])
+            if "reason" in last:
+                sup["reason"] = last["reason"]
+        budgets = [r["budget_left"] for r in supervisor if "budget_left" in r]
+        if budgets:
+            sup["budget_left"] = budgets[-1]
+        summary["supervisor"] = sup
     if run_ends:
         summary["run_end"] = run_ends[-1]
     return summary
@@ -243,6 +274,22 @@ def render(summary: dict) -> str:
             f"pod: {summary['pod_records']} records, worst cross-host step "
             f"spread {summary['pod_step_spread_ms_max']:.1f} ms"
         )
+    sup = summary.get("supervisor")
+    if sup:
+        outcome = sup.get("outcome", "running")
+        lines.append(
+            f"supervisor: {sup['launches']} launch(es), {sup['restarts']} "
+            f"restart(s), {sup['kills']} kill(s) — {outcome}"
+            + (f" ({sup['reason']})" if sup.get("reason") else "")
+        )
+        if sup["classifications"]:
+            counts: dict[str, int] = {}
+            for c in sup["classifications"]:
+                counts[c] = counts.get(c, 0) + 1
+            detail = ", ".join(f"{k}×{v}" for k, v in sorted(counts.items()))
+            lines.append(f"  death classifications: {detail}")
+        if "budget_left" in sup:
+            lines.append(f"  restart budget left: {sup['budget_left']}")
     inc = summary.get("incidents", {})
     if inc:
         detail = ", ".join(f"{k}×{v}" for k, v in sorted(inc.items()))
