@@ -68,6 +68,7 @@ BENCH_FALLBACK_METRICS = {
     "step": ("moco_v2_r50_pretrain_throughput_per_chip", "imgs/sec/chip"),
     "input": ("host_staging_throughput", "imgs/sec"),
     "e2e": ("moco_v2_r50_e2e_input_fed_throughput_per_chip", "imgs/sec/chip"),
+    "serve": ("serve_embed_p95_latency_ms", "ms"),
 }
 
 # TPU attempt sizing (all unit-tested via plan_tpu_attempt):
@@ -216,6 +217,10 @@ def _orchestrate_body(mode: str, orch: "_Orchestrator") -> None:
         orch.best = orch.run("cpu", "input", 300.0, _CPU_ENV)
         orch.flush()
         return
+    if mode == "serve":  # ISSUE 5: warm-bucket serving latency (CPU proxy)
+        orch.best = orch.run("cpu", "serve", 300.0, _CPU_ENV)
+        orch.flush()
+        return
 
     # 1) guaranteed number first: the CPU proxy, printed immediately as a
     #    provisional record so an external SIGKILL cannot erase everything
@@ -277,6 +282,17 @@ def _orchestrate_body(mode: str, orch: "_Orchestrator") -> None:
                                       if k in e2e}
         else:
             orch.errors.append("e2e: skipped, step attempt consumed the budget")
+
+    # 6) serving-path trajectory row (ISSUE 5): the tiny-model full-stack
+    #    latency/occupancy record (bench_serve), folded like input's. LAST
+    #    on purpose: on a tight day the headline step/e2e measurements
+    #    outrank it, and its CPU child is cheap when the budget is fat
+    if mode == "step" and orch.remaining() > 60.0:
+        srv = orch.run("serve", "serve", 90.0, _CPU_ENV)
+        if srv is not None:
+            orch.extras["serve"] = {k: srv[k] for k in
+                                    ("metric", "value", "unit", "detail")
+                                    if k in srv}
 
     orch.flush()
 
@@ -545,6 +561,75 @@ def bench_e2e():
     )
 
 
+def bench_serve():
+    """Warm-bucket serving percentiles (ISSUE 5): the FULL serving stack —
+    stdlib HTTP front end, micro-batcher, bucketed-compile engine — under
+    the closed-loop generator (tools/serve_bench.run_load) at fixed
+    concurrency, on the tiny CPU proxy model. Every bucket is compiled at
+    warmup, so the record measures steady-state batching, not compiles;
+    the trajectory row to watch is p95 vs the deadline knob and mean
+    batch occupancy at this concurrency."""
+    import jax
+    import jax.numpy as jnp
+
+    from moco_tpu.models import build_backbone
+    from moco_tpu.serve import EmbeddingEngine, EmbedService, ServeFrontend
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tools"))
+    import serve_bench
+
+    concurrency, total = 32, 512
+    deadline_ms = 5000.0
+    model = build_backbone("resnet_tiny", cifar_stem=True)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False
+    )
+    engine = EmbeddingEngine(
+        model, variables["params"], variables.get("batch_stats", {}),
+        image_size=32, buckets=(1, 8, 32),
+    )
+    t0 = time.perf_counter()
+    service = EmbedService(
+        engine, flush_ms=5.0, max_queue=128,
+        request_deadline_ms=deadline_ms, cache_mb=0,
+    )
+    warmup_s = time.perf_counter() - t0
+    frontend = ServeFrontend(service, port=0)
+    frontend.start()
+    try:
+        summary = serve_bench.run_load(
+            frontend.url, concurrency=concurrency, total_requests=total,
+            image_size=32, pool=64, timeout_s=30.0,
+        )
+        stats = service.stats()
+    finally:
+        service.drain()
+        frontend.shutdown()
+    assert summary["lost"] == 0, f"lost requests: {summary['lost_detail']}"
+    print(
+        json.dumps(
+            {
+                "metric": "serve_tiny_cpu_embed_p95_latency_ms",
+                "value": summary["latency_ms"]["p95"],
+                "unit": "ms",
+                "vs_baseline": 0.0,
+                "compile_warmup_s": round(warmup_s, 1),
+                "detail": {
+                    "concurrency": concurrency,
+                    "requests": total,
+                    "throughput_rps": summary["throughput_rps"],
+                    "latency_ms": summary["latency_ms"],
+                    "shed": summary["shed"],
+                    "batches": stats["batches"],
+                    "occupancy_mean": stats["occupancy_mean"],
+                    "buckets": stats["buckets"],
+                },
+            }
+        )
+    )
+
+
 def main():
     import jax
 
@@ -623,7 +708,8 @@ if __name__ == "__main__":
     import argparse
 
     parser = argparse.ArgumentParser()
-    parser.add_argument("--mode", choices=["step", "input", "e2e", "probe"],
+    parser.add_argument("--mode",
+                        choices=["step", "input", "e2e", "probe", "serve"],
                         default="step")
     parser.add_argument(
         "--child", action="store_true",
@@ -644,6 +730,8 @@ if __name__ == "__main__":
             bench_probe()
         elif args.mode == "input":
             bench_input()
+        elif args.mode == "serve":
+            bench_serve()
         else:
             # persistent compile cache (VERDICT r4 #2a): first healthy
             # contact pays the compile, later children measure

@@ -19,12 +19,21 @@ from __future__ import annotations
 
 import json
 import os
+from typing import TYPE_CHECKING
 
 import jax
 import numpy as np
-import orbax.checkpoint as ocp
 
-from moco_tpu.train_state import TrainState
+if TYPE_CHECKING:  # annotation-only: see the import note below
+    import orbax.checkpoint as ocp
+
+    from moco_tpu.train_state import TrainState
+
+# orbax and TrainState (which drags optax) are imported INSIDE the Orbax
+# save/restore functions, not at module level: this module is also the
+# inference-side loader (`load_for_inference`, the serve/ path — lint R6
+# promises serving processes stay free of the optimizer stack), and the
+# flat export/import half needs neither.
 
 
 # ---------------------------------------------------------------------------
@@ -32,7 +41,9 @@ from moco_tpu.train_state import TrainState
 # ---------------------------------------------------------------------------
 
 
-def checkpoint_manager(directory: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
+def checkpoint_manager(directory: str, max_to_keep: int = 3) -> "ocp.CheckpointManager":
+    import orbax.checkpoint as ocp
+
     return ocp.CheckpointManager(
         os.path.abspath(directory),
         options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
@@ -123,6 +134,8 @@ def save_checkpoint(
     loses its cheap integrity gate. `position` (the `(epoch, next_batch)`
     the restored run should resume the data stream at) is recorded as a
     sidecar — see `write_position`."""
+    import orbax.checkpoint as ocp
+
     finalize_checkpoints(mgr)
     write_position(str(mgr.directory), step, position)
     mgr.save(step, args=ocp.args.StandardSave(_unkey(state)))
@@ -157,6 +170,8 @@ def _restore_step(
     step: int,
     sharding=None,
 ) -> TrainState:
+    import orbax.checkpoint as ocp
+
     target = _unkey(abstract_state)
     if sharding is not None:
         import jax.numpy as jnp
@@ -658,19 +673,104 @@ def timm_to_vit(
     return tree
 
 
+# ---------------------------------------------------------------------------
+# Checkpoint dialects — the ONE table every non-training consumer routes on
+# ---------------------------------------------------------------------------
+
+# name → predicate over the flat key set. Ordered: first match wins. This is
+# the single source of truth for "what kind of checkpoint is this" — the
+# lincls surgery, the serve/ inference loader, and the Detectron2 converter
+# all route through it, so a new dialect lands in exactly one place.
+CHECKPOINT_DIALECTS: tuple[tuple[str, object], ...] = (
+    # v3 ResNet backbones (tree export; projector/predictor already dropped)
+    ("v3_tree", lambda flat: any(k.startswith("backbone/") for k in flat)),
+    # timm VisionTransformer names with fused qkv (ours, or any timm ViT)
+    ("timm_vit", lambda flat: "patch_embed.proj.weight" in flat),
+    # the reference's torchvision dialect (v1/v2 ResNet, `module.encoder_q.*`)
+    ("torchvision_encoder_q",
+     lambda flat: any(k.startswith("module.encoder_q.") for k in flat)),
+)
+
+
+def detect_dialect(flat: dict[str, np.ndarray]) -> str:
+    """Classify a flat checkpoint dict against `CHECKPOINT_DIALECTS`.
+    Raises with the known-dialect list on a miss — every consumer used to
+    fall through to its own (differently-worded) failure."""
+    for name, pred in CHECKPOINT_DIALECTS:
+        if pred(flat):
+            return name
+    known = ", ".join(name for name, _ in CHECKPOINT_DIALECTS)
+    raise ValueError(
+        f"checkpoint matches no known dialect (looked for: {known}); "
+        f"got keys like {sorted(flat)[:3]}"
+    )
+
+
 def load_pretrained_backbone(path: str, num_heads: int = 12) -> tuple[dict, dict]:
     """Dialect-routed load of a pretrained backbone: torchvision
     `module.encoder_q.*` (v1/v2 ResNet, head dropped), timm `blocks.N.*`
     (ViT — ours or any fused-qkv timm checkpoint), or `backbone/*` trees
     (v3 ResNet). Returns (params, batch_stats) as numpy trees."""
     flat = import_encoder_q(path)
-    if any(k.startswith("backbone/") for k in flat):
+    dialect = detect_dialect(flat)
+    if dialect == "v3_tree":
         return unflatten_tree(flat, "backbone/"), unflatten_tree(
             flat, "backbone_stats/"
         )
-    if "patch_embed.proj.weight" in flat:
+    if dialect == "timm_vit":
         return timm_to_vit(flat, num_heads=num_heads), {}
     return torchvision_to_resnet(flat)
+
+
+def load_for_inference(
+    path: str,
+    arch: str,
+    *,
+    image_size: int = 224,
+    cifar_stem: bool = False,
+):
+    """Checkpoint-surgery restore for every non-training consumer (the
+    lincls probe, the serve/ embedding service, detectron2-adjacent
+    tooling): build the feature-mode encoder for `arch`, load `path`
+    through the dialect table, and verify the surgery yielded EXACTLY the
+    backbone tree (the reference asserts missing_keys == {fc.*}; here the
+    equivalent is a path-set equality against a fresh init). Returns
+    `(model, params, batch_stats)` with the trees as jax arrays.
+
+    ViT archs split the timm fused qkv with THIS arch's head count — a
+    wrong count mis-partitions heads silently, which is why consumers must
+    not call `load_pretrained_backbone` with a guessed `num_heads`."""
+    import jax.numpy as jnp
+
+    from moco_tpu.models import build_backbone
+
+    model = build_backbone(arch, cifar_stem=cifar_stem)
+    params, stats = load_pretrained_backbone(
+        path, num_heads=getattr(model, "num_heads", 12)
+    )
+    ref = jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0),
+            jnp.zeros((1, image_size, image_size, 3)),
+            train=False,
+        )
+    )
+    ref_paths = {jax.tree_util.keystr(p) for p, _ in
+                 jax.tree_util.tree_leaves_with_path(ref["params"])}
+    got_paths = {jax.tree_util.keystr(p) for p, _ in
+                 jax.tree_util.tree_leaves_with_path(params)}
+    if ref_paths != got_paths:
+        missing = sorted(ref_paths - got_paths)[:5]
+        extra = sorted(got_paths - ref_paths)[:5]
+        raise ValueError(
+            f"checkpoint surgery mismatch for arch {arch!r}: "
+            f"missing {missing}, extra {extra}"
+        )
+    return (
+        model,
+        jax.tree.map(jnp.asarray, params),
+        jax.tree.map(jnp.asarray, stats),
+    )
 
 
 def import_encoder_q(path: str) -> dict[str, np.ndarray]:

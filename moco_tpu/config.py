@@ -277,6 +277,65 @@ class EvalConfig:
         return _effective_lr(self)
 
 
+@dataclass
+class ServeConfig:
+    """Online embedding service (moco_tpu/serve/; ISSUE 5). One flat
+    dataclass like the drivers', exposed by tools/serve.py as `--flags`."""
+
+    pretrained: str = ""              # exported encoder (.safetensors/.npz),
+                                      # any dialect in checkpoint.CHECKPOINT_DIALECTS
+    arch: str = "resnet50"
+    image_size: int = 224
+    cifar_stem: bool = False
+    host: str = "127.0.0.1"
+    port: int = 8080                  # 0 = ephemeral (tests/bench)
+    # micro-batcher (serve/batcher.py): flush on bucket-full OR deadline
+    buckets: tuple[int, ...] = (1, 8, 32, 128)  # padded compile shapes; the
+                                      # jitted apply compiles exactly these
+    flush_ms: float = 10.0            # max coalesce wait before a partial
+                                      # bucket flushes (the latency a lone
+                                      # request pays to help the next one)
+    max_queue: int = 256              # admission-queue depth; beyond it
+                                      # requests shed with `overloaded`
+    request_deadline_ms: float = 2000.0  # per-request budget; expired-in-
+                                      # queue requests shed with
+                                      # `deadline_exceeded`, never stall
+    embed_cache_mb: int = 64          # content-hash embedding LRU budget
+                                      # (serve/cache.py; 0 = off)
+    # observability (same events.jsonl stream as training)
+    telemetry_dir: str = ""           # "" = telemetry off
+    snapshot_every: int = 25          # serve-record cadence, in batches
+    # optional kNN-classify endpoint over a precomputed feature bank
+    knn_bank: str = ""                # npz with `features` [N,D] + `labels` [N]
+    knn_k: int = 200
+    knn_temperature: float = 0.07
+    num_classes: int = 0              # 0 = derive from bank labels
+    drain_timeout_s: float = 60.0     # SIGTERM: max wait for in-flight work
+
+    def __post_init__(self):
+        # the ONE bucket-ladder rule, shared with the runtime's own check
+        # (serve/batcher.py is numpy+stdlib — safe at config-import time)
+        from moco_tpu.serve.batcher import validate_buckets
+
+        b = validate_buckets(self.buckets)
+        if self.max_queue < b[-1]:
+            raise ValueError(
+                f"max_queue ({self.max_queue}) must hold at least one full "
+                f"bucket ({b[-1]})"
+            )
+        if self.flush_ms < 0 or self.request_deadline_ms <= 0:
+            raise ValueError(
+                "flush_ms must be >= 0 and request_deadline_ms > 0"
+            )
+        if self.embed_cache_mb < 0:
+            raise ValueError(
+                f"embed_cache_mb must be >= 0, got {self.embed_cache_mb}"
+            )
+
+    def replace(self, **kw) -> "ServeConfig":
+        return dataclasses.replace(self, **kw)
+
+
 # ---------------------------------------------------------------------------
 # The five BASELINE.json target configs as named presets.
 # ---------------------------------------------------------------------------
@@ -431,7 +490,9 @@ def add_config_flags(parser, config_cls) -> None:
                 type=lambda s: s.lower() in ("1", "true", "yes"),
                 default=None,
             )
-        elif f.name == "schedule":
+        elif isinstance(f.default, tuple):
+            # int-tuple fields (schedule milestones, serve buckets):
+            # space-separated on the CLI, retupled in collect_overrides
             parser.add_argument(name, type=int, nargs="*", default=None)
         else:
             caster = (
@@ -451,8 +512,9 @@ def collect_overrides(args, config_cls) -> dict:
         for f in dataclasses.fields(config_cls)
         if getattr(args, f.name, None) is not None
     }
-    if "schedule" in overrides:
-        overrides["schedule"] = tuple(overrides["schedule"])
+    for f in dataclasses.fields(config_cls):
+        if isinstance(f.default, tuple) and f.name in overrides:
+            overrides[f.name] = tuple(overrides[f.name])
     return overrides
 
 

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from moco_tpu.checkpoint import load_pretrained_backbone
+from moco_tpu.checkpoint import load_for_inference, load_pretrained_backbone
 from moco_tpu.config import EvalConfig
 from moco_tpu.data import (
     augment_batch,
@@ -38,7 +38,6 @@ from moco_tpu.data import (
     eval_aug_config,
     v1_aug_config,
 )
-from moco_tpu.models import build_resnet
 from moco_tpu.ops.losses import contrastive_accuracy
 from moco_tpu.ops.schedules import cosine_lr, step_lr
 from moco_tpu.parallel.mesh import create_mesh, local_batch_size
@@ -49,50 +48,17 @@ from moco_tpu.utils.meters import AverageMeter, ProgressMeter
 def load_frozen_backbone(config: EvalConfig):
     """Backbone (feature mode) + pretrained weights via checkpoint surgery.
 
-    Accepts both checkpoint dialects: `module.encoder_q.*` torchvision names
-    (v1/v2 ResNet exports and reference-style checkpoints) and the
-    `v3_backbone/*` tree dialect (v3 ViT/ResNet backbones, whose probe
-    protocol likewise drops projector+predictor)."""
-    if config.arch.startswith("vit"):
-        from moco_tpu.models.vit import build_vit
-
-        model = build_vit(config.arch, num_classes=None)
-        # timm-dialect checkpoints carry a FUSED qkv; split it with THIS
-        # arch's head count (a wrong count mis-partitions heads silently)
-        num_heads = model.num_heads
-    else:
-        model = build_resnet(
-            config.arch, num_classes=None, cifar_stem=config.cifar_stem
-        )
-        num_heads = 12
-    params, stats = load_pretrained_backbone(config.pretrained, num_heads=num_heads)
-    if not params:
-        raise ValueError(
-            f"no 'module.encoder_q.*' / 'v3_backbone/*' entries found in "
-            f"{config.pretrained!r}"
-        )
-    # the reference asserts missing_keys == {fc.weight, fc.bias}; here the
-    # equivalent check is that the surgery yields exactly the backbone tree
-    ref = jax.eval_shape(
-        lambda: model.init(
-            jax.random.key(0),
-            jnp.zeros((1, config.image_size, config.image_size, 3)),
-            train=False,
-        )
+    Thin wrapper over `checkpoint.load_for_inference` — the shared
+    dialect-table loader the serve/ subsystem uses too (ISSUE 5), so both
+    checkpoint dialects (`module.encoder_q.*` torchvision names and the
+    timm fused-qkv / `backbone/*` tree exports) and the surgery's
+    exact-backbone-tree check live in exactly one place."""
+    return load_for_inference(
+        config.pretrained,
+        config.arch,
+        image_size=config.image_size,
+        cifar_stem=config.cifar_stem,
     )
-    ref_paths = {jax.tree_util.keystr(p) for p, _ in
-                 jax.tree_util.tree_leaves_with_path(ref["params"])}
-    got_paths = {jax.tree_util.keystr(p) for p, _ in
-                 jax.tree_util.tree_leaves_with_path(params)}
-    if ref_paths != got_paths:
-        missing = sorted(ref_paths - got_paths)[:5]
-        extra = sorted(got_paths - ref_paths)[:5]
-        raise ValueError(
-            f"checkpoint surgery mismatch: missing {missing}, extra {extra}"
-        )
-    params = jax.tree.map(jnp.asarray, params)
-    stats = jax.tree.map(jnp.asarray, stats)
-    return model, params, stats
 
 
 def init_classifier(rng, feat_dim: int, num_classes: int):

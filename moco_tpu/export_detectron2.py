@@ -24,7 +24,7 @@ import pickle
 
 import numpy as np
 
-from moco_tpu.checkpoint import import_encoder_q
+from moco_tpu.checkpoint import detect_dialect, import_encoder_q
 
 _BN_LEAVES = {
     "weight": "norm.weight",
@@ -78,7 +78,20 @@ def torchvision_flat_to_detectron2(
 
 
 def convert(src: str, dst: str, prefix: str = "module.encoder_q.") -> dict:
-    model = torchvision_flat_to_detectron2(import_encoder_q(src), prefix)
+    flat = import_encoder_q(src)
+    if prefix == "module.encoder_q.":
+        # shared dialect table (checkpoint.CHECKPOINT_DIALECTS): a ViT or
+        # v3-tree export has no Detectron2 C4 mapping — say so up front
+        # instead of the generic "no entries found" tail error. A custom
+        # prefix opts out: the caller is naming their own dialect.
+        dialect = detect_dialect(flat)
+        if dialect != "torchvision_encoder_q":
+            raise ValueError(
+                f"{src!r} is a {dialect!r} checkpoint; only the torchvision "
+                "`module.encoder_q.*` ResNet dialect maps onto Detectron2 "
+                "C4 names (ViT/v3-tree backbones have no C4 equivalent)"
+            )
+    model = torchvision_flat_to_detectron2(flat, prefix)
     obj = {
         "model": model,
         "__author__": "moco_tpu",

@@ -10,7 +10,22 @@ from moco_tpu.models.resnet import (
 )
 from moco_tpu.models.heads import V3Predictor, V3Projector
 
+
+def build_backbone(arch: str, *, cifar_stem: bool = False, num_classes=None):
+    """Feature-mode encoder for NON-TRAINING consumers (the lincls probe,
+    the serve/ embedding service): one arch router for both families, so
+    'which constructor does this arch use' is decided in exactly one place.
+    `num_classes=None` yields pooled backbone features, the transfer
+    product both consumers read."""
+    if arch.startswith("vit"):
+        from moco_tpu.models.vit import build_vit
+
+        return build_vit(arch, num_classes=num_classes)
+    return build_resnet(arch, num_classes=num_classes, cifar_stem=cifar_stem)
+
+
 __all__ = [
+    "build_backbone",
     "ARCHS",
     "FEATURE_DIMS",
     "ResNet",

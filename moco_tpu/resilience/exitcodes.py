@@ -24,6 +24,10 @@ EXIT_CONFIG_ERROR = 45         # bad preset/flag/config validation: restarting
                                # the same argv can never succeed
 EXIT_DATA_QUALITY = 46         # DataQualityError: the dataset itself is bad
                                # (decode-abort threshold); restart won't fix it
+EXIT_SERVE_BIND = 47           # tools/serve.py could not bind its host:port
+                               # (address in use / privileged port): restarting
+                               # the same argv races the same socket — an
+                               # orchestrator should reschedule, not retry-loop
 
 # argparse's own usage-error exit — not ours to raise, but the classifier
 # treats it like EXIT_CONFIG_ERROR (same argv can never succeed)
@@ -35,5 +39,6 @@ EXIT_CODE_NAMES: dict[int, str] = {
     EXIT_ROLLBACK_EXHAUSTED: "rollback_exhausted",
     EXIT_CONFIG_ERROR: "config_error",
     EXIT_DATA_QUALITY: "data_quality",
+    EXIT_SERVE_BIND: "serve_bind",
     USAGE_ERROR: "usage_error",
 }

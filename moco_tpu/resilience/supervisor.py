@@ -52,6 +52,7 @@ from moco_tpu.resilience.exitcodes import (
     EXIT_OK,
     EXIT_PREEMPTED,
     EXIT_ROLLBACK_EXHAUSTED,
+    EXIT_SERVE_BIND,
     USAGE_ERROR,
 )
 from moco_tpu.utils.logging import log_event
@@ -72,11 +73,12 @@ CLASS_NATIVE_CRASH = "native_crash"            # SIGSEGV/SIGABRT/SIGBUS/...
 CLASS_OOM = "oom"                              # SIGKILL + high tail RSS
 CLASS_KILLED = "killed"                        # external SIGKILL/SIGTERM death
 CLASS_CRASH = "crash"                          # any other nonzero exit
+CLASS_SERVE_BIND = "serve_bind"                # serve.py couldn't bind its port
 
 # classes where restarting can never help — the run is OVER
 FATAL_CLASSES = frozenset({
     CLASS_CLEAN, CLASS_ROLLBACK_EXHAUSTED, CLASS_CONFIG_ERROR,
-    CLASS_DATA_QUALITY,
+    CLASS_DATA_QUALITY, CLASS_SERVE_BIND,
 })
 RESTARTABLE_CLASSES = frozenset({
     CLASS_PREEMPTED, CLASS_HANG, CLASS_NATIVE_CRASH, CLASS_OOM,
@@ -169,6 +171,9 @@ def classify_exit(
         EXIT_ROLLBACK_EXHAUSTED: CLASS_ROLLBACK_EXHAUSTED,
         EXIT_CONFIG_ERROR: CLASS_CONFIG_ERROR,
         EXIT_DATA_QUALITY: CLASS_DATA_QUALITY,
+        # relaunching the same argv races the same occupied socket: the
+        # orchestrator one level up must reschedule, not retry-loop
+        EXIT_SERVE_BIND: CLASS_SERVE_BIND,
         USAGE_ERROR: CLASS_CONFIG_ERROR,
     }
     if returncode in named:

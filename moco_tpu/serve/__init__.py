@@ -1,0 +1,48 @@
+"""moco_tpu.serve — online embedding service (ISSUE 5).
+
+The repo's first non-training workload: a request-driven inference
+runtime over a pretraining checkpoint's momentum encoder. Layers:
+
+    batcher.py   dynamic micro-batching (flush on size OR deadline),
+                 bounded admission queue, load shedding, drain semantics
+    engine.py    bucketed-compile jitted apply (pad to 1/8/32/128 —
+                 a fixed program set, zero recompiles under load)
+    cache.py     content-hash embedding LRU (byte-budgeted, the
+                 data/canvas_cache.py pattern)
+    service.py   the request path: validation → cache → batcher →
+                 engine (+ optional kNN classify), telemetry snapshots
+    http.py      stdlib-HTTP front end (tools/serve.py mounts it)
+
+Train-free by lint (tools/lint_robustness.py R6): nothing here may
+import train, train_step, or optimizer modules — the server stays
+import-light and can never grow a training dependency by accident."""
+
+from moco_tpu.serve.batcher import (
+    DeadlineExceededError,
+    DrainingError,
+    MicroBatcher,
+    OverloadedError,
+    PendingRequest,
+    RejectionError,
+    bucket_for,
+)
+from moco_tpu.serve.cache import EmbeddingCache
+from moco_tpu.serve.engine import DEFAULT_BUCKETS, EmbeddingEngine
+from moco_tpu.serve.http import ServeFrontend, decode_image
+from moco_tpu.serve.service import EmbedService
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "DeadlineExceededError",
+    "DrainingError",
+    "EmbedService",
+    "EmbeddingCache",
+    "EmbeddingEngine",
+    "MicroBatcher",
+    "OverloadedError",
+    "PendingRequest",
+    "RejectionError",
+    "ServeFrontend",
+    "bucket_for",
+    "decode_image",
+]

@@ -1,0 +1,307 @@
+"""Dynamic micro-batcher with admission control (ISSUE 5 tentpole).
+
+Online serving inverts pretraining's batching problem: requests arrive one
+at a time, but the accelerator amortizes fixed per-call cost only over
+LARGE calls. The batcher coalesces concurrent requests into few device
+calls — flush on max-batch-size OR deadline, whichever comes first — the
+same amortize-without-unbounded-latency tradeoff FAST (PAPERS.md) makes
+for all-to-all scheduling.
+
+Contracts the tests pin:
+
+  - FIFO: requests are batched strictly in arrival order; a deadline
+    flush takes the OLDEST prefix of the queue.
+  - shed, never stall: the admission queue has a bounded depth — at
+    capacity `submit` raises `OverloadedError` immediately (the caller
+    gets a structured rejection with a retry hint, not unbounded
+    latency). A request whose own deadline passed while it sat queued is
+    resolved with `DeadlineExceededError` instead of wasting a device
+    slot on an answer nobody is waiting for.
+  - drain, never drop: `drain()` stops admission and flushes EVERYTHING
+    already accepted — every in-flight request completes (SIGTERM
+    semantics; tools/serve.py wires it through the
+    resilience/preemption.py handler-chaining pattern).
+
+The batcher never touches jax: `run_batch` is any `[n, ...] -> [n, D]`
+callable (serve/engine.py's bucketed-compile `embed` in production, a
+stub in the unit tests), so batching semantics are testable without a
+compile in sight.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+
+class RejectionError(Exception):
+    """A request that got a structured DECISION instead of a result.
+
+    `code` is the wire-visible discriminator (the HTTP front end maps it
+    to a status + JSON error body); `fields` carry machine-readable
+    context (e.g. `retry_after_ms`)."""
+
+    code = "rejected"
+    http_status = 503
+
+    def __init__(self, msg: str, **fields):
+        super().__init__(msg)
+        self.fields = fields
+
+
+class OverloadedError(RejectionError):
+    """Admission queue at capacity — shed at the door, retry later."""
+
+    code = "overloaded"
+    http_status = 503
+
+
+class DeadlineExceededError(RejectionError):
+    """The request's own deadline passed before a device slot reached it."""
+
+    code = "deadline_exceeded"
+    http_status = 504
+
+
+class DrainingError(RejectionError):
+    """The service is shutting down; new work is rejected, in-flight
+    work completes."""
+
+    code = "draining"
+    http_status = 503
+
+
+def bucket_for(n: int, buckets: tuple[int, ...]) -> int:
+    """Smallest padded bucket shape that fits `n` requests. `buckets` is
+    ascending; `n` must fit the largest (the batcher never pops more)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} exceeds the largest bucket {buckets[-1]}")
+
+
+def validate_buckets(buckets) -> tuple[int, ...]:
+    b = tuple(int(x) for x in buckets)
+    if not b or any(x < 1 for x in b) or list(b) != sorted(set(b)):
+        raise ValueError(
+            f"buckets must be ascending unique positive sizes, got {buckets!r}"
+        )
+    return b
+
+
+class PendingRequest:
+    """One queued request: payload in, exactly-one-of (result, error) out."""
+
+    __slots__ = ("payload", "enqueue_t", "deadline_t", "result", "error",
+                 "_done")
+
+    def __init__(self, payload, enqueue_t: float, deadline_t: float):
+        self.payload = payload
+        self.enqueue_t = enqueue_t
+        self.deadline_t = deadline_t
+        self.result = None
+        self.error: Exception | None = None
+        self._done = threading.Event()
+
+    def resolve(self, result=None, error: Exception | None = None) -> None:
+        self.result = result
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout: float | None = None):
+        """Block for the batcher's decision; raises the structured error
+        for shed/failed requests. The batcher resolves every accepted
+        request (execute, shed, or drain-reject), so a timeout here means
+        the flusher thread itself died — surfaced as a hard error, never
+        a silent None."""
+        if not self._done.wait(timeout):
+            raise RuntimeError(
+                "batcher never resolved the request (flusher thread dead?)"
+            )
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class MicroBatcher:
+    """Deadline-or-size flushing over a bounded FIFO admission queue.
+
+    `run_batch([n, ...]) -> [n, D]` executes one coalesced batch (n is
+    ≤ `buckets[-1]`; padding to the bucket shape is the executor's
+    concern — see serve/engine.py). `on_batch(n, bucket, wait_s)` fires
+    after each executed batch with the real occupancy numerator, the
+    padded bucket, and the oldest request's queue wait.
+    """
+
+    def __init__(
+        self,
+        run_batch,
+        *,
+        buckets: tuple[int, ...] = (1, 8, 32, 128),
+        flush_ms: float = 10.0,
+        max_queue: int = 256,
+        default_deadline_ms: float = 2000.0,
+        on_batch=None,
+        name: str = "embed",
+    ):
+        self.buckets = validate_buckets(buckets)
+        if max_queue < self.buckets[-1]:
+            raise ValueError(
+                f"max_queue ({max_queue}) must hold at least one full "
+                f"bucket ({self.buckets[-1]}) or the largest bucket can "
+                "never fill"
+            )
+        self._run_batch = run_batch
+        self._flush_s = float(flush_ms) / 1e3
+        self.max_queue = int(max_queue)
+        self._default_deadline_s = float(default_deadline_ms) / 1e3
+        self._on_batch = on_batch
+        self._queue: deque[PendingRequest] = deque()
+        self._cond = threading.Condition()
+        self._draining = False
+        self._closed = False
+        self._inflight = 0
+        # counters (read under the cond lock by stats consumers)
+        self.submitted = 0
+        self.completed = 0
+        self.shed_overload = 0
+        self.shed_deadline = 0
+        self.batch_errors = 0
+        self.batches = 0
+        self.occupancy_sum = 0.0
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True, name=f"{name}-flusher"
+        )
+        self._thread.start()
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, payload, deadline_s: float | None = None) -> PendingRequest:
+        """Admit one request or raise a structured rejection IMMEDIATELY
+        (bounded queue: the overloaded answer must be cheap and instant,
+        never a timeout the client discovers on their own)."""
+        now = time.monotonic()
+        if deadline_s is None:
+            deadline_s = self._default_deadline_s
+        pending = PendingRequest(payload, now, now + deadline_s)
+        with self._cond:
+            if self._draining or self._closed:
+                raise DrainingError("service is draining; not accepting work")
+            if len(self._queue) >= self.max_queue:
+                self.shed_overload += 1
+                # crude but honest hint: full queues ahead of this request
+                # each take at least one flush window to clear
+                depth_batches = 1 + len(self._queue) // self.buckets[-1]
+                raise OverloadedError(
+                    f"admission queue full ({self.max_queue})",
+                    retry_after_ms=round(depth_batches * self._flush_s * 1e3, 1),
+                )
+            self.submitted += 1
+            self._queue.append(pending)
+            self._cond.notify_all()
+        return pending
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue) + self._inflight
+
+    @property
+    def occupancy_mean(self) -> float:
+        with self._cond:
+            return self.occupancy_sum / self.batches if self.batches else 0.0
+
+    # -- the flusher ---------------------------------------------------------
+    def _flush_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if not self._queue:  # closed and empty: done
+                    return
+                # coalesce window: more work may arrive until the oldest
+                # request's flush deadline OR a full largest bucket,
+                # whichever first; draining flushes immediately
+                flush_at = self._queue[0].enqueue_t + self._flush_s
+                while (len(self._queue) < self.buckets[-1]
+                       and not self._draining and not self._closed):
+                    remaining = flush_at - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                take = min(len(self._queue), self.buckets[-1])
+                batch = [self._queue.popleft() for _ in range(take)]
+                self._inflight = len(batch)
+            try:
+                self._execute(batch)
+            finally:
+                with self._cond:
+                    self._inflight = 0
+                    self._cond.notify_all()
+
+    def _execute(self, batch: list[PendingRequest]) -> None:
+        now = time.monotonic()
+        live, expired = [], []
+        for p in batch:
+            (live if p.deadline_t > now else expired).append(p)
+        for p in expired:
+            p.resolve(error=DeadlineExceededError(
+                f"deadline passed after {now - p.enqueue_t:.3f}s in queue",
+                queued_ms=round((now - p.enqueue_t) * 1e3, 1),
+            ))
+        with self._cond:
+            self.shed_deadline += len(expired)
+        if not live:
+            return
+        bucket = bucket_for(len(live), self.buckets)
+        try:
+            out = np.asarray(self._run_batch(
+                np.stack([p.payload for p in live])
+            ))
+        except Exception as e:  # executor failure: every rider sees it
+            for p in live:
+                p.resolve(error=e)
+            with self._cond:
+                self.batch_errors += 1
+            return
+        for p, row in zip(live, out):
+            p.resolve(result=np.asarray(row))
+        wait_s = now - live[0].enqueue_t
+        with self._cond:
+            self.completed += len(live)
+            self.batches += 1
+            self.occupancy_sum += len(live) / bucket
+        if self._on_batch is not None:
+            self._on_batch(len(live), bucket, wait_s)
+
+    # -- shutdown ------------------------------------------------------------
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Stop admitting, flush everything already accepted, return True
+        once every accepted request is resolved (False on timeout — the
+        caller decides whether to hard-stop)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while self._queue or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=min(remaining, 0.1))
+        return True
+
+    def close(self, drain: bool = True, timeout_s: float = 60.0) -> None:
+        """Drain (default) or reject-what's-queued, then stop the flusher."""
+        if drain:
+            self.drain(timeout_s)
+        with self._cond:
+            self._draining = True
+            self._closed = True
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        for p in leftovers:
+            p.resolve(error=DrainingError("batcher closed before execution"))
+        self._thread.join(timeout=5.0)

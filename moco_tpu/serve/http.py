@@ -1,0 +1,168 @@
+"""stdlib HTTP front end over `EmbedService` (ISSUE 5 tentpole).
+
+One thread per connection (`ThreadingHTTPServer`): each request blocks in
+`service.embed` until its coalesced batch resolves, which is exactly the
+concurrency shape the micro-batcher feeds on — N in-flight HTTP requests
+ARE the batch. No web framework: the container bakes no server deps, and
+the protocol is four routes of JSON.
+
+    POST /v1/embed   {"image_b64": <raw uint8 RGB bytes>, "shape": [S,S,3]}
+                     (or {"pixels": nested list}; optional "deadline_ms")
+                 →   200 {"embedding": [...], "cached": bool}
+    POST /v1/knn     same body → 200 {"class": int, "cached": bool}
+                     (+"embedding" when "return_embedding" is true)
+    GET  /healthz    200 {"status": "ok"} | 503 {"status": "draining"}
+    GET  /stats      200 <service.stats()>
+
+Rejections are STRUCTURED, never hangs: the batcher's typed errors map to
+HTTP statuses with a machine-readable body — 503 `{"error":
+"overloaded", "retry_after_ms": ...}`, 504 `{"error":
+"deadline_exceeded"}`, 503 `{"error": "draining"}` — so a load balancer
+or client can distinguish shed from broken."""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from moco_tpu.serve.batcher import RejectionError
+
+
+def decode_image(req: dict) -> np.ndarray:
+    """Request body → one uint8 image array; ValueError on any malformed
+    input (the front end maps it to 400, never a traceback)."""
+    if "image_b64" in req:
+        shape = req.get("shape")
+        if (not isinstance(shape, (list, tuple)) or len(shape) != 3):
+            raise ValueError('image_b64 needs "shape": [h, w, 3]')
+        try:
+            buf = base64.b64decode(req["image_b64"], validate=True)
+        except (ValueError, TypeError) as e:
+            raise ValueError(f"image_b64 is not valid base64: {e}")
+        arr = np.frombuffer(buf, np.uint8)
+        expected = int(np.prod([int(s) for s in shape]))
+        if arr.size != expected:
+            raise ValueError(
+                f"image_b64 carries {arr.size} bytes, shape {shape} "
+                f"needs {expected}"
+            )
+        return arr.reshape([int(s) for s in shape])
+    if "pixels" in req:
+        try:
+            return np.asarray(req["pixels"], np.uint8)
+        except (ValueError, TypeError) as e:
+            raise ValueError(f"pixels is not a uint8 image array: {e}")
+    raise ValueError('body needs "image_b64"+"shape" or "pixels"')
+
+
+def _make_handler(service):
+    class Handler(BaseHTTPRequestHandler):
+        # keep-alive: closed-loop clients (serve_bench) reuse connections
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: D102
+            # per-request stderr lines drown real events under load; the
+            # structured channel is service.stats()/telemetry
+            pass
+
+        def _send(self, status: int, obj: dict) -> None:
+            body = json.dumps(obj).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                if service.draining:
+                    self._send(503, {"status": "draining"})
+                else:
+                    self._send(200, {"status": "ok",
+                                     "queue_depth": service.batcher.queue_depth})
+            elif self.path == "/stats":
+                self._send(200, service.stats())
+            else:
+                self._send(404, {"error": "not_found", "path": self.path})
+
+        def do_POST(self):
+            if self.path not in ("/v1/embed", "/v1/knn"):
+                # body must still be consumed on HTTP/1.1 keep-alive
+                self.rfile.read(int(self.headers.get("Content-Length") or 0))
+                self._send(404, {"error": "not_found", "path": self.path})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError("body must be a JSON object")
+                image = decode_image(req)
+                deadline_ms = req.get("deadline_ms")
+                deadline_s = (
+                    float(deadline_ms) / 1e3 if deadline_ms else None
+                )
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": "bad_request", "detail": str(e)})
+                return
+            try:
+                if self.path == "/v1/knn":
+                    cls_id, embedding, cached = service.classify(
+                        image, deadline_s
+                    )
+                    resp = {"class": cls_id, "cached": cached}
+                    if req.get("return_embedding"):
+                        resp["embedding"] = [float(v) for v in embedding]
+                else:
+                    embedding, cached = service.embed(image, deadline_s)
+                    resp = {"embedding": [float(v) for v in embedding],
+                            "cached": cached}
+                self._send(200, resp)
+            except RejectionError as e:
+                self._send(e.http_status,
+                           {"error": e.code, "detail": str(e), **e.fields})
+            except ValueError as e:  # e.g. wrong resolution for this model
+                self._send(400, {"error": "bad_request", "detail": str(e)})
+            except Exception as e:  # a handler crash must answer, not hang
+                self._send(500, {"error": "internal", "detail": repr(e)})
+
+    return Handler
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    # listen backlog: socketserver's default of 5 resets connections the
+    # moment a few dozen closed-loop clients reconnect at once (urllib
+    # opens a fresh TCP connection per request) — the admission queue, not
+    # the kernel backlog, is where this service sheds load
+    request_queue_size = 128
+
+
+class ServeFrontend:
+    """Owns the `ThreadingHTTPServer`; `port=0` binds an ephemeral port
+    (tests, in-process bench) and exposes the real one as `.port`."""
+
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
+        self.server = _Server((host, port), _make_handler(service))
+        self.host, self.port = self.server.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True, name="serve-http"
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,228 @@
+"""Request-path orchestration: admission → cache → batcher → engine
+(ISSUE 5 tentpole).
+
+`EmbedService` is the front end's single entry point. One `embed()` call
+walks: shape/dtype validation, the content-hash embedding LRU, the
+micro-batcher's bounded admission queue, a bucketed device call, and the
+telemetry instruments — returning a feature row or raising one of the
+structured rejections from serve/batcher.py. `classify()` rides the same
+path and finishes with a weighted-kNN vote against a precomputed feature
+bank (`ops/knn.knn_predict`, the InstDisc protocol the pretrain monitor
+uses).
+
+Telemetry: latency / batch-occupancy / queue-wait histograms feed
+cumulative `kind: "serve"` snapshot records into the SAME events.jsonl
+stream training writes (`MetricsRegistry`), emitted every
+`snapshot_every` batches and once at drain — `tools/telemetry_report.py`
+renders the last snapshot as its `serve:` section.
+
+Shutdown: `drain()` (SIGTERM in tools/serve.py) stops admission, lets
+every accepted request finish, and flushes the final snapshot — reject
+new, complete old, then exit."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from moco_tpu.serve.batcher import MicroBatcher
+from moco_tpu.serve.cache import EmbeddingCache
+from moco_tpu.telemetry.registry import Histogram
+from moco_tpu.utils.logging import log_event
+
+# most-recent observations the stats histograms keep: a server runs for
+# weeks — unbounded reservoirs (fine for a bounded training run) would
+# grow memory and per-snapshot sort cost forever, and an operator wants
+# RECENT percentiles from /stats anyway
+STATS_WINDOW = 8192
+
+
+class EmbedService:
+    def __init__(
+        self,
+        engine,
+        *,
+        flush_ms: float = 10.0,
+        max_queue: int = 256,
+        request_deadline_ms: float = 2000.0,
+        cache_mb: int = 0,
+        registry=None,
+        snapshot_every: int = 25,
+        knn_bank: np.ndarray | None = None,
+        knn_labels: np.ndarray | None = None,
+        num_classes: int = 0,
+        knn_k: int = 200,
+        knn_temperature: float = 0.07,
+    ):
+        self.engine = engine
+        self.feat_dim = engine.warmup()  # every bucket compiled before traffic
+        self.cache = EmbeddingCache(cache_mb) if cache_mb else None
+        self.registry = registry
+        self.snapshot_every = max(int(snapshot_every), 1)
+        self.draining = False
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.served = 0
+        self._started = time.time()
+        self._h_latency = Histogram("serve_latency_s", window=STATS_WINDOW)
+        self._h_queue_wait = Histogram("serve_queue_wait_s",
+                                       window=STATS_WINDOW)
+        self._request_deadline_s = float(request_deadline_ms) / 1e3
+        self.batcher = MicroBatcher(
+            engine.embed,
+            buckets=engine.buckets,
+            flush_ms=flush_ms,
+            max_queue=max_queue,
+            default_deadline_ms=request_deadline_ms,
+            on_batch=self._note_batch,
+        )
+        self._knn = None
+        if knn_bank is not None:
+            if knn_labels is None or len(knn_bank) != len(knn_labels):
+                raise ValueError("knn_bank needs matching knn_labels")
+            labels = np.asarray(knn_labels, np.int32)
+            self._knn = {
+                "bank": np.asarray(knn_bank, np.float32),
+                "labels": labels,
+                "num_classes": int(num_classes or labels.max() + 1),
+                "k": int(knn_k),
+                "temperature": float(knn_temperature),
+            }
+            # pre-compile the kNN program too: the first classify must not
+            # pay a trace under live traffic (same rule as engine.warmup)
+            self._knn_predict(np.ones((1, self.feat_dim), np.float32))
+        if self.registry is not None:
+            self.registry.emit(
+                "serve_start",
+                image_size=engine.image_size,
+                feat_dim=self.feat_dim,
+                buckets=list(engine.buckets),
+                flush_ms=flush_ms,
+                max_queue=max_queue,
+                request_deadline_ms=request_deadline_ms,
+                cache_mb=cache_mb,
+                knn_bank_size=0 if self._knn is None else len(self._knn["bank"]),
+            )
+
+    # -- request paths -------------------------------------------------------
+    def embed(self, image: np.ndarray,
+              deadline_s: float | None = None) -> tuple[np.ndarray, bool]:
+        """One request: returns `(embedding, cache_hit)` or raises a
+        `RejectionError` subclass (overloaded / deadline_exceeded /
+        draining) — the caller always gets a decision."""
+        image = self._validate(image)
+        with self._lock:
+            self.requests += 1
+        t0 = time.monotonic()
+        key = None
+        if self.cache is not None:
+            key = EmbeddingCache.key_for(image)
+            hit = self.cache.get(key)
+            if hit is not None:
+                with self._lock:
+                    self.served += 1
+                self._h_latency.observe(time.monotonic() - t0)
+                return hit, True
+        pending = self.batcher.submit(image, deadline_s)
+        # generous slack over the request deadline: the batcher ALWAYS
+        # resolves accepted requests, so this only guards a dead flusher
+        result = pending.wait(
+            timeout=(deadline_s or self._request_deadline_s) + 30.0
+        )
+        self._h_latency.observe(time.monotonic() - t0)
+        if self.cache is not None:
+            self.cache.put(key, result)
+        with self._lock:
+            self.served += 1
+        return result, False
+
+    def classify(self, image: np.ndarray,
+                 deadline_s: float | None = None) -> tuple[int, np.ndarray, bool]:
+        """kNN-classify against the precomputed feature bank: returns
+        `(class_id, embedding, cache_hit)`."""
+        if self._knn is None:
+            raise ValueError(
+                "no kNN feature bank configured (serve with --knn-bank)"
+            )
+        embedding, cached = self.embed(image, deadline_s)
+        pred = self._knn_predict(embedding[None, :])
+        return int(pred[0]), embedding, cached
+
+    def _knn_predict(self, features: np.ndarray) -> np.ndarray:
+        from moco_tpu.ops.knn import knn_predict
+
+        k = self._knn
+        return np.asarray(knn_predict(
+            features, k["bank"], k["labels"], k["num_classes"],
+            k=k["k"], temperature=k["temperature"],
+        ))
+
+    def _validate(self, image) -> np.ndarray:
+        image = np.asarray(image)
+        s = self.engine.image_size
+        if image.shape != (s, s, 3) or image.dtype != np.uint8:
+            raise ValueError(
+                f"expected one [{s}, {s}, 3] uint8 image, got "
+                f"{image.shape} {image.dtype}"
+            )
+        return image
+
+    # -- telemetry -----------------------------------------------------------
+    def _note_batch(self, n: int, bucket: int, wait_s: float) -> None:
+        self._h_queue_wait.observe(wait_s)
+        if (self.registry is not None
+                and self.batcher.batches % self.snapshot_every == 0):
+            self.registry.emit("serve", **self.stats())
+
+    def stats(self) -> dict:
+        """Cumulative snapshot — the `/stats` payload AND the `kind:
+        "serve"` telemetry record (the report reads the LAST one)."""
+        b = self.batcher
+        with self._lock:
+            requests, served = self.requests, self.served
+        out = {
+            "requests": requests,
+            "served": served,
+            "shed_overload": b.shed_overload,
+            "shed_deadline": b.shed_deadline,
+            "batch_errors": b.batch_errors,
+            "batches": b.batches,
+            "occupancy_mean": round(b.occupancy_mean, 4),
+            "queue_depth": b.queue_depth,
+            "buckets": list(b.buckets),
+            "latency_ms": self._h_latency.percentiles_ms(),
+            "queue_wait_ms": self._h_queue_wait.percentiles_ms(),
+            "draining": self.draining,
+            "uptime_s": round(time.time() - self._started, 1),
+        }
+        if self.cache is not None:
+            out["cache"] = {
+                "hits": self.cache.hits,
+                "misses": self.cache.misses,
+                "hit_rate": round(self.cache.hit_rate, 4),
+                "entries": self.cache.entries,
+                "bytes": self.cache.cached_bytes,
+            }
+        return out
+
+    # -- shutdown ------------------------------------------------------------
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Reject new work, complete everything accepted, flush the final
+        telemetry snapshot. Idempotent. Returns False when in-flight work
+        outlived `timeout_s` (the batcher is then closed non-draining and
+        leftovers get a structured rejection — never a silent drop)."""
+        self.draining = True
+        completed = self.batcher.drain(timeout_s)
+        if not completed:
+            log_event(
+                "serve",
+                f"drain timed out after {timeout_s:.0f}s; rejecting the "
+                "remainder with structured errors",
+            )
+        self.batcher.close(drain=False)
+        if self.registry is not None:
+            self.registry.emit("serve", final=True, **self.stats())
+            self.registry.flush()
+        return completed

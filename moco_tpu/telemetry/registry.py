@@ -33,6 +33,7 @@ import math
 import os
 import threading
 import time
+from collections import deque
 
 SCHEMA_VERSION = 1
 
@@ -71,14 +72,23 @@ class Gauge:
 class Histogram:
     """Reservoir of observations with exact percentiles (step times, MFU).
 
-    Keeps every observation: at one float per step a multi-day 1M-step run
-    is ~8 MB — exactness is worth more here than a sketch, because the
-    p99 regression a perf PR must catch lives in the tail.
+    By default keeps every observation: at one float per step a multi-day
+    1M-step run is ~8 MB — exactness is worth more here than a sketch,
+    because the p99 regression a perf PR must catch lives in the tail.
+    A LONG-LIVED process with unbounded observation rate (the serving
+    path: ISSUE 5) must pass `window` instead — a bounded deque of the
+    most recent N observations, so memory and per-snapshot sort cost stay
+    flat forever and the percentiles describe recent behavior (which is
+    what an operator watching a server wants anyway).
     """
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, window: int | None = None):
         self.name = name
-        self._values: list[float] = []
+        if window is not None and window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self._values = (
+            deque(maxlen=int(window)) if window is not None else []
+        )
 
     def observe(self, value: float) -> None:
         self._values.append(float(value))
@@ -107,6 +117,12 @@ class Histogram:
         rank = max(0, min(len(ordered) - 1, round(q / 100.0 * (len(ordered) - 1))))
         return ordered[int(rank)]
 
+    def percentiles_ms(self, qs=(50, 95, 99)) -> dict:
+        """{"p50": ..., ...} of seconds-valued observations in ms — THE
+        shared percentile-record shape (BENCH_*.json folds, serve
+        snapshots, telemetry_report rendering)."""
+        return {f"p{q}": round(self.percentile(q) * 1e3, 3) for q in qs}
+
 
 def _json_safe(value):
     """RFC-8259-safe record values: json.dumps would happily write bare
@@ -130,12 +146,13 @@ def _json_safe(value):
 
 
 def percentiles_ms(values, qs=(50, 95, 99)) -> dict:
-    """{"p50": ..., ...} of `values` (seconds) in milliseconds — the shared
-    shape bench.py folds into BENCH_*.json and telemetry_report prints."""
+    """{"p50": ..., ...} of `values` (seconds) in milliseconds — the
+    free-function form of `Histogram.percentiles_ms` for callers holding
+    a plain list (bench.py's BENCH_*.json folds)."""
     h = Histogram("tmp")
     for v in values:
         h.observe(float(v))
-    return {f"p{q}": round(h.percentile(q) * 1e3, 3) for q in qs}
+    return h.percentiles_ms(qs)
 
 
 class MetricsRegistry:

@@ -15,9 +15,37 @@ Call before building any jitted program. Opt out with MOCO_TPU_NO_CACHE=1
 from __future__ import annotations
 
 import os
+import time
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".jax_cache")
+
+# pid+ms alone can collide for two derivations in the same process tick
+# (tests, a supervisor deriving twice); the sequence number cannot
+_RUN_SEQ = 0
+
+
+def per_run_cache_dir(base: str | None = None, tag: str = "run") -> str:
+    """A compile-cache dir no OTHER process shares (ISSUE 5 satellite,
+    applying the PR 4 finding): SIGKILL-grade death mid-write can poison
+    this jax build's persistent cache — later loads of the poisoned entry
+    heap-corrupt into a native-crash loop. Kill-risk workloads (supervised
+    drills, a served process under an external orchestrator) therefore
+    derive a fresh `<base>/per_run/<tag>-<pid>-<ms>` dir: poison dies with
+    the run instead of infecting every later process on the host.
+
+    Stdlib-only on purpose — tools/supervise.py (which never imports jax)
+    sets this as the child's MOCO_TPU_CACHE_DIR. Old per-run dirs are just
+    cache; delete them freely."""
+    global _RUN_SEQ
+    root = base or os.environ.get("MOCO_TPU_CACHE_ROOT") or DEFAULT_CACHE_DIR
+    _RUN_SEQ += 1
+    path = os.path.join(
+        root, "per_run",
+        f"{tag}-{os.getpid()}-{int(time.time() * 1e3)}-{_RUN_SEQ}",
+    )
+    os.makedirs(path, exist_ok=True)
+    return path
 
 
 def enable_persistent_cache(cache_dir: str | None = None) -> str | None:

@@ -46,6 +46,9 @@ E2E = {"metric": "moco_v2_r50_e2e_input_fed_throughput_per_chip",
        "value": 1500.0, "unit": "imgs/sec/chip", "vs_baseline": 8.9}
 PROBE = {"metric": "tpu_liveness", "value": 1.0, "unit": "devices",
          "vs_baseline": 0.0, "platform": "tpu"}
+SERVE = {"metric": "serve_tiny_cpu_embed_p95_latency_ms", "value": 159.3,
+         "unit": "ms", "vs_baseline": 0.0,
+         "detail": {"occupancy_mean": 0.57, "throughput_rps": 441.7}}
 
 
 def _fake_child(clock, outcomes):
@@ -74,7 +77,7 @@ def test_tpu_up_prints_provisional_then_upgraded_line(capsys):
     clock = FakeClock()
     fake, calls = _fake_child(clock, {"step": lambda cpu: PROXY if cpu else TPU,
                                       "input": INPUT, "e2e": E2E,
-                                      "probe": PROBE})
+                                      "probe": PROBE, "serve": SERVE})
     p1, p2 = _patch_clock(clock)
     with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
         bench.orchestrate("step")
@@ -84,9 +87,14 @@ def test_tpu_up_prints_provisional_then_upgraded_line(capsys):
     assert out[-1]["metric"] == TPU["metric"] and out[-1]["value"] == 2000.0
     assert out[-1]["input"]["value"] == 482.1
     assert out[-1]["e2e"]["value"] == 1500.0
+    # the serving trajectory row (ISSUE 5) folded in, always on CPU
+    assert out[-1]["serve"]["value"] == SERVE["value"]
+    serve_calls = [c for c in calls if c[0] == "serve"]
+    assert len(serve_calls) == 1 and serve_calls[0][2].get("MOCO_TPU_FORCE_CPU")
     # cpu proxy ran FIRST; e2e ran on the TPU (no FORCE_CPU) since TPU worked
     assert calls[0][0] == "step" and calls[0][2].get("MOCO_TPU_FORCE_CPU")
-    assert calls[-1][0] == "e2e" and not calls[-1][2].get("MOCO_TPU_FORCE_CPU")
+    e2e_calls = [c for c in calls if c[0] == "e2e"]
+    assert e2e_calls and not e2e_calls[-1][2].get("MOCO_TPU_FORCE_CPU")
 
 
 def test_tpu_hang_keeps_proxy_and_stays_inside_budget(capsys):
@@ -122,7 +130,8 @@ def test_dead_probe_skips_tpu_attempt_entirely(capsys):
     fake, calls = _fake_child(
         clock, {"step": lambda cpu: PROXY if cpu else None,
                 "input": INPUT, "e2e": lambda cpu: E2E if cpu else None,
-                "probe": None})  # probe hangs to its cap
+                "probe": None,  # probe hangs to its cap
+                "serve": SERVE})
     p1, p2 = _patch_clock(clock)
     with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
         bench.orchestrate("step")
@@ -133,8 +142,9 @@ def test_dead_probe_skips_tpu_attempt_entirely(capsys):
                 if c[0] == "step" and not c[2].get("MOCO_TPU_FORCE_CPU")]
     assert any("liveness probe" in e for e in out[-1]["degraded_from"])
     assert out[-1]["e2e"]["value"] == E2E["value"]
-    # dead day completes fast: proxy + input + probe cap + e2e
-    assert clock.t - t_start <= 45 + 45 + bench.TPU_PROBE_CAP_S + 45 + 1
+    assert out[-1]["serve"]["value"] == SERVE["value"]
+    # dead day completes fast: proxy + input + probe cap + e2e + serve
+    assert clock.t - t_start <= 45 + 45 + bench.TPU_PROBE_CAP_S + 45 + 45 + 1
 
 
 def test_live_probe_gives_step_the_remaining_budget(capsys):
@@ -143,7 +153,7 @@ def test_live_probe_gives_step_the_remaining_budget(capsys):
     clock = FakeClock()
     fake, calls = _fake_child(clock, {"step": lambda cpu: PROXY if cpu else TPU,
                                       "input": INPUT, "e2e": E2E,
-                                      "probe": PROBE})
+                                      "probe": PROBE, "serve": SERVE})
     p1, p2 = _patch_clock(clock)
     with p1, p2, mock.patch.object(bench, "_run_child", side_effect=fake):
         bench.orchestrate("step")

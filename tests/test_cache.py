@@ -43,3 +43,26 @@ def test_no_cache_opt_out(tmp_path, monkeypatch):
     assert enable_persistent_cache(str(tmp_path / "x")) is None
     assert jax.config.jax_compilation_cache_dir == before
     assert not os.path.exists(tmp_path / "x")
+
+
+def test_per_run_cache_dir_isolated_and_created(tmp_path):
+    """ISSUE 5 satellite (PR 4 finding): kill-risk processes get a cache
+    dir no other process shares, under <base>/per_run, created eagerly."""
+    from moco_tpu.utils.cache import per_run_cache_dir
+
+    a = per_run_cache_dir(str(tmp_path), tag="drill")
+    b = per_run_cache_dir(str(tmp_path), tag="drill")
+    assert a != b  # two calls, two runs: never shared
+    for d in (a, b):
+        assert os.path.isdir(d)
+        assert os.path.dirname(d) == str(tmp_path / "per_run")
+        assert os.path.basename(d).startswith("drill-")
+
+
+def test_per_run_cache_dir_honors_cache_root_env(tmp_path, monkeypatch):
+    from moco_tpu.utils.cache import per_run_cache_dir
+
+    monkeypatch.setenv("MOCO_TPU_CACHE_ROOT", str(tmp_path / "root"))
+    d = per_run_cache_dir(tag="serve")
+    assert d.startswith(str(tmp_path / "root"))
+    assert os.path.isdir(d)

@@ -156,6 +156,59 @@ def test_r5_accepts_named_constants_and_bare_exits(tmp_path):
     assert lint.check_file(str(tmp_path / "ok.py")) == []
 
 
+def _serve_file(tmp_path, body: str):
+    """A file positioned under a moco_tpu/serve/ tree (R6's scope)."""
+    path = tmp_path / "moco_tpu" / "serve" / "mod.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(body)
+    return str(path)
+
+
+def test_r6_detects_train_imports_under_serve(tmp_path):
+    """R6 (ISSUE 5): the serving runtime must stay train-free — every
+    import spelling of the forbidden modules is flagged, including lazy
+    (function-body) imports."""
+    found = lint.check_file(_serve_file(
+        tmp_path,
+        "import optax\n"
+        "import moco_tpu.train_step\n"
+        "from moco_tpu.train import main\n"
+        "from moco_tpu import train_state\n"
+        "from moco_tpu.ops.schedules import cosine_lr\n"
+        "def lazy():\n"
+        "    from moco_tpu.v3_step import build_v3_step\n"
+    ))
+    assert len(found) == 6
+    assert all("train-free" in v for v in found)
+
+
+def test_r6_allows_inference_imports_under_serve(tmp_path):
+    assert lint.check_file(_serve_file(
+        tmp_path,
+        "import numpy as np\n"
+        "from moco_tpu.checkpoint import load_for_inference\n"
+        "from moco_tpu.ops.knn import knn_predict\n"
+        "from moco_tpu.telemetry.registry import Histogram\n"
+        "from moco_tpu.serve.batcher import MicroBatcher\n"
+    )) == []
+
+
+def test_r6_scoped_to_serve_tree(tmp_path):
+    """The SAME import outside moco_tpu/serve/ is legal — R6 protects the
+    serving runtime, not the whole package."""
+    path = tmp_path / "moco_tpu" / "evals" / "mod.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("import optax\n")
+    assert lint.check_file(str(path)) == []
+
+
+def test_r6_holds_for_the_real_serve_package():
+    """Tier-1 gate: the shipped moco_tpu/serve/ is train-free."""
+    serve_dir = os.path.join(REPO, "moco_tpu", "serve")
+    r6 = [v for v in lint.check_tree(serve_dir) if "train-free" in v]
+    assert r6 == [], r6
+
+
 def test_r4_holds_for_bench_and_package_call_sites():
     """The real construction sites (train driver, lincls, bench.py — the
     latter outside the package tree, held to R4 here) stay clean."""

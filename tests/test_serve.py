@@ -1,0 +1,696 @@
+"""moco_tpu/serve/ — the online embedding service (ISSUE 5).
+
+Pins the batching semantics the tentpole promises:
+  - bit-identical embeddings regardless of batch composition (solo vs
+    coalesced into a full bucket, and vs a direct jitted `model.apply`);
+  - a FIXED compile set: warmup compiles exactly the bucket ladder and
+    load never adds a program;
+  - deadline-flush ordering (FIFO; a partial bucket flushes when the
+    oldest request's coalesce window closes);
+  - shed-not-stall under synthetic overload (bounded admission queue,
+    immediate structured rejection, queued work still completes);
+  - drain completing every in-flight request while rejecting new work;
+plus the HTTP front end's wire contract, the content-hash embedding LRU,
+the kNN endpoint, the telemetry `serve:` report section, and the ISSUE 5
+CPU-smoke acceptance run (32 concurrent clients, >= 200 requests, zero
+lost, p95 within deadline, mean occupancy >= 50%, bit-identical rows).
+"""
+
+from __future__ import annotations
+
+import base64
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+serve_bench = _load_tool("serve_bench")
+telemetry_report = _load_tool("telemetry_report")
+
+
+# ---------------------------------------------------------------------------
+# batcher semantics (stub executor — no jax anywhere near these)
+# ---------------------------------------------------------------------------
+
+
+def _mk_batcher(run_batch=None, **kw):
+    from moco_tpu.serve.batcher import MicroBatcher
+
+    return MicroBatcher(run_batch or (lambda x: x * 2.0), **kw)
+
+
+def test_bucket_for_picks_smallest_fitting():
+    from moco_tpu.serve.batcher import bucket_for
+
+    assert [bucket_for(n, (1, 8, 32)) for n in (1, 2, 8, 9, 32)] == \
+        [1, 8, 8, 32, 32]
+    with pytest.raises(ValueError):
+        bucket_for(33, (1, 8, 32))
+
+
+def test_bucket_validation():
+    from moco_tpu.serve.batcher import validate_buckets
+
+    assert validate_buckets([1, 8]) == (1, 8)
+    for bad in ((), (0, 4), (8, 1), (4, 4)):
+        with pytest.raises(ValueError):
+            validate_buckets(bad)
+
+
+def test_deadline_flush_ordering_fifo():
+    """A partial bucket flushes when the OLDEST request's window closes,
+    and rows come back in arrival order (each request gets ITS OWN row)."""
+    seen = []
+
+    def run(batch):
+        seen.append(batch.copy())
+        return batch * 2.0
+
+    b = _mk_batcher(run, buckets=(1, 4, 8), flush_ms=40.0, max_queue=16)
+    try:
+        pendings = [b.submit(np.array([float(i)])) for i in range(3)]
+        results = [p.wait(timeout=5.0) for p in pendings]
+        for i, r in enumerate(results):
+            assert r[0] == 2.0 * i  # FIFO row mapping survived coalescing
+        assert len(seen) == 1 and seen[0].shape[0] == 3  # one deadline flush
+        assert b.batches == 1 and b.occupancy_sum == pytest.approx(3 / 4)
+    finally:
+        b.close()
+
+
+def test_flush_on_full_bucket_before_deadline():
+    b = _mk_batcher(buckets=(1, 4), flush_ms=10_000.0, max_queue=8)
+    try:
+        t0 = time.monotonic()
+        pendings = [b.submit(np.array([float(i)])) for i in range(4)]
+        for p in pendings:
+            p.wait(timeout=5.0)
+        # a 10 s coalesce window did NOT gate the full bucket
+        assert time.monotonic() - t0 < 5.0
+        assert b.batches == 1 and b.occupancy_mean == pytest.approx(1.0)
+    finally:
+        b.close()
+
+
+class _Gate:
+    """An executor the test can hold closed to build synthetic overload."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = 0
+
+    def __call__(self, batch):
+        self.calls += 1
+        if not self.release.wait(timeout=10.0):
+            raise RuntimeError("test gate never released")
+        return batch * 2.0
+
+
+def test_overload_sheds_immediately_not_stalls():
+    from moco_tpu.serve.batcher import OverloadedError
+
+    gate = _Gate()
+    b = _mk_batcher(gate, buckets=(1, 2), flush_ms=1.0, max_queue=4,
+                    default_deadline_ms=30_000.0)
+    try:
+        first = b.submit(np.array([0.0]))  # flusher picks it up, blocks
+        time.sleep(0.1)
+        queued = [b.submit(np.array([float(i)])) for i in range(1, 5)]
+        t0 = time.monotonic()
+        with pytest.raises(OverloadedError) as exc:
+            b.submit(np.array([99.0]))
+        assert time.monotonic() - t0 < 1.0  # shed at the door, no waiting
+        assert exc.value.fields["retry_after_ms"] > 0
+        assert b.shed_overload == 1
+        gate.release.set()
+        # everything ACCEPTED still completes (shed, never dropped)
+        for p in [first] + queued:
+            assert p.wait(timeout=10.0)[0] == 2.0 * p.payload[0]
+    finally:
+        b.close()
+
+
+def test_expired_in_queue_shed_with_structured_error():
+    from moco_tpu.serve.batcher import DeadlineExceededError
+
+    gate = _Gate()
+    b = _mk_batcher(gate, buckets=(1,), flush_ms=1.0, max_queue=8)
+    try:
+        first = b.submit(np.array([0.0]), deadline_s=30.0)
+        time.sleep(0.05)
+        doomed = b.submit(np.array([1.0]), deadline_s=0.01)
+        time.sleep(0.1)  # its deadline passes while the gate is closed
+        gate.release.set()
+        assert first.wait(timeout=10.0)[0] == 0.0
+        with pytest.raises(DeadlineExceededError):
+            doomed.wait(timeout=10.0)
+        assert b.shed_deadline == 1
+    finally:
+        b.close()
+
+
+def test_drain_completes_inflight_rejects_new():
+    from moco_tpu.serve.batcher import DrainingError
+
+    gate = _Gate()
+    b = _mk_batcher(gate, buckets=(1, 4), flush_ms=5.0, max_queue=16,
+                    default_deadline_ms=30_000.0)
+    pendings = [b.submit(np.array([float(i)])) for i in range(6)]
+    done = threading.Event()
+    drained = []
+
+    def drainer():
+        drained.append(b.drain(timeout_s=20.0))
+        done.set()
+
+    threading.Thread(target=drainer, daemon=True).start()
+    time.sleep(0.1)
+    with pytest.raises(DrainingError):
+        b.submit(np.array([99.0]))  # new work rejected the moment drain starts
+    gate.release.set()
+    assert done.wait(timeout=20.0)
+    assert drained == [True]
+    for i, p in enumerate(pendings):  # every accepted request completed
+        assert p.wait(timeout=1.0)[0] == 2.0 * i
+    b.close()
+
+
+def test_close_without_drain_rejects_leftovers():
+    from moco_tpu.serve.batcher import DrainingError
+
+    gate = _Gate()
+    b = _mk_batcher(gate, buckets=(1,), flush_ms=1.0, max_queue=8)
+    first = b.submit(np.array([0.0]))
+    time.sleep(0.05)
+    leftover = b.submit(np.array([1.0]))
+    gate.release.set()
+    b.close(drain=False)
+    first.wait(timeout=10.0)  # the in-flight one still resolved
+    with pytest.raises(DrainingError):
+        leftover.wait(timeout=1.0)  # structured rejection, never a hang
+
+
+def test_batch_error_propagates_to_every_rider():
+    def boom(batch):
+        raise RuntimeError("device on fire")
+
+    b = _mk_batcher(boom, buckets=(1, 4), flush_ms=5.0, max_queue=8)
+    try:
+        pendings = [b.submit(np.array([float(i)])) for i in range(3)]
+        for p in pendings:
+            with pytest.raises(RuntimeError, match="device on fire"):
+                p.wait(timeout=5.0)
+        assert b.batch_errors == 1
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# engine: bucketed compiles + bit-identical embeddings
+# ---------------------------------------------------------------------------
+
+BUCKETS = (1, 4, 16)
+SIZE = 32
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    import jax
+    import jax.numpy as jnp
+
+    from moco_tpu.models import build_backbone
+    from moco_tpu.serve import EmbeddingEngine
+
+    model = build_backbone("resnet_tiny", cifar_stem=True)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, SIZE, SIZE, 3)), train=False
+    )
+    params = variables["params"]
+    stats = variables.get("batch_stats", {})
+    engine = EmbeddingEngine(model, params, stats, image_size=SIZE,
+                             buckets=BUCKETS)
+    engine.warmup()
+
+    @jax.jit
+    def direct_apply(p, s, u8):
+        """The reference computation: a direct jitted `model.apply` with
+        params as ARGUMENTS (how every step program in this repo runs;
+        closed-over params constant-fold differently at 1-ulp scale)."""
+        from moco_tpu.data.augment import IMAGENET_INV_STD, IMAGENET_MEAN
+
+        x = u8.astype(jnp.float32) / 255.0
+        x = (x - IMAGENET_MEAN) * IMAGENET_INV_STD
+        return model.apply({"params": p, "batch_stats": s}, x, train=False)
+
+    def direct(u8_batch):
+        return np.asarray(direct_apply(params, stats, u8_batch))
+
+    return engine, direct
+
+
+def _imgs(n, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (n, SIZE, SIZE, 3)
+    ).astype(np.uint8)
+
+
+def test_engine_fixed_compile_set_under_load(tiny_setup):
+    engine, _ = tiny_setup
+    before = engine.compiled_programs()
+    for n in (1, 2, 3, 4, 5, 9, 16, 1, 7):  # every bucket + odd sizes
+        out = engine.embed(_imgs(n, seed=n))
+        assert out.shape == (n, engine.feat_dim)
+    after = engine.compiled_programs()
+    if before is not None:  # introspection available on this jax build
+        assert before == after == len(BUCKETS)  # zero recompiles under load
+
+
+def test_embeddings_bit_identical_across_batch_composition(tiny_setup):
+    """The same image embeds BIT-identically: solo (1-bucket), coalesced
+    among strangers into a full bucket, zero-padded into a partial
+    bucket, and vs the direct jitted model.apply."""
+    engine, direct = tiny_setup
+    imgs = _imgs(16, seed=42)
+    ref = direct(imgs)
+    solo = engine.embed(imgs[:1])[0]
+    full = engine.embed(imgs)
+    partial = engine.embed(imgs[:3])  # padded 3 -> 4-bucket
+    assert np.array_equal(solo, ref[0])
+    assert np.array_equal(full, ref)
+    assert np.array_equal(partial, ref[:3])
+    # composition-independence directly: same row, different neighbors
+    reordered = engine.embed(imgs[::-1].copy())
+    assert np.array_equal(reordered[-1], full[0])
+
+
+def test_engine_validates_shape_and_dtype(tiny_setup):
+    engine, _ = tiny_setup
+    with pytest.raises(ValueError):
+        engine.embed(_imgs(1).astype(np.float32))
+    with pytest.raises(ValueError):
+        engine.embed(np.zeros((1, SIZE, SIZE + 1, 3), np.uint8))
+    with pytest.raises(ValueError):
+        engine.embed(_imgs(BUCKETS[-1] + 1))  # beyond the largest bucket
+
+
+# ---------------------------------------------------------------------------
+# embedding cache
+# ---------------------------------------------------------------------------
+
+
+def test_embedding_cache_content_keyed_lru():
+    from moco_tpu.serve.cache import EmbeddingCache
+
+    cache = EmbeddingCache(1)  # 1 MiB
+    a, b = _imgs(2, seed=7)
+    ka, kb = EmbeddingCache.key_for(a), EmbeddingCache.key_for(b)
+    assert ka != kb
+    assert ka == EmbeddingCache.key_for(a.copy())  # content, not identity
+    assert cache.get(ka) is None and cache.misses == 1
+    cache.put(ka, np.arange(4, dtype=np.float32))
+    got = cache.get(ka)
+    assert np.array_equal(got, [0, 1, 2, 3]) and cache.hits == 1
+    # stored row is a private copy: caller mutation can't corrupt it
+    src = np.ones(4, np.float32)
+    cache.put(kb, src)
+    src[:] = 99.0
+    assert np.array_equal(cache.get(kb), np.ones(4))
+
+
+def test_embedding_cache_byte_budget_evicts_lru():
+    from moco_tpu.serve.cache import EmbeddingCache
+
+    cache = EmbeddingCache(1)  # 1 MiB budget
+    row = np.zeros(65536, np.float32)  # 256 KiB each -> 4 fit
+    for i in range(5):
+        cache.put(f"k{i}", row)
+    assert cache.entries == 4
+    assert cache.get("k0") is None       # LRU victim
+    assert cache.get("k4") is not None
+    assert cache.cached_bytes <= 2**20
+    # an entry larger than the whole budget is never cached
+    cache.put("huge", np.zeros(2**19, np.float64))
+    assert cache.get("huge") is None
+
+
+# ---------------------------------------------------------------------------
+# service + HTTP front end
+# ---------------------------------------------------------------------------
+
+
+def _post(url, body, timeout=15.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _b64_body(img, **extra):
+    return {"image_b64": base64.b64encode(img.tobytes()).decode("ascii"),
+            "shape": list(img.shape), **extra}
+
+
+@pytest.fixture()
+def served(tiny_setup, tmp_path):
+    """A full service + frontend on an ephemeral port, with telemetry and
+    a kNN bank, torn down cleanly."""
+    from moco_tpu.serve import EmbedService, ServeFrontend
+    from moco_tpu.telemetry.registry import MetricsRegistry
+
+    engine, direct = tiny_setup
+    bank_imgs = _imgs(32, seed=5)
+    bank = direct(bank_imgs)
+    labels = np.arange(32) % 4
+    events = str(tmp_path / "events.jsonl")
+    registry = MetricsRegistry(events, flush_every=1)
+    service = EmbedService(
+        engine, flush_ms=5.0, max_queue=64, request_deadline_ms=10_000.0,
+        cache_mb=4, registry=registry, snapshot_every=1,
+        knn_bank=bank, knn_labels=labels, knn_k=5,
+    )
+    frontend = ServeFrontend(service, port=0)
+    frontend.start()
+    try:
+        yield service, frontend, direct, (bank, labels), events
+    finally:
+        service.drain(timeout_s=10.0)
+        frontend.shutdown()
+        registry.close()
+
+
+def test_http_embed_knn_health_stats(served):
+    from moco_tpu.ops.knn import knn_predict
+
+    service, frontend, direct, (bank, labels), _ = served
+    img = _imgs(1, seed=11)[0]
+
+    status, resp = _post(frontend.url + "/v1/embed", _b64_body(img))
+    assert status == 200 and resp["cached"] is False
+    emb = np.asarray(resp["embedding"], np.float32)
+    assert np.array_equal(emb, direct(img[None])[0])  # wire fidelity
+
+    status, resp = _post(frontend.url + "/v1/embed", _b64_body(img))
+    assert status == 200 and resp["cached"] is True  # content-hash hit
+
+    status, resp = _post(frontend.url + "/v1/knn",
+                         _b64_body(img, return_embedding=True))
+    assert status == 200
+    expected = int(np.asarray(knn_predict(
+        emb[None], bank, labels.astype(np.int32), 4, k=5,
+    ))[0])
+    assert resp["class"] == expected
+    assert np.array_equal(np.asarray(resp["embedding"], np.float32), emb)
+
+    with urllib.request.urlopen(frontend.url + "/healthz", timeout=5) as r:
+        assert json.loads(r.read())["status"] == "ok"
+    with urllib.request.urlopen(frontend.url + "/stats", timeout=5) as r:
+        stats = json.loads(r.read())
+    assert stats["requests"] >= 3 and stats["served"] >= 3
+    assert stats["cache"]["hits"] >= 1
+
+
+def test_http_structured_errors(served):
+    service, frontend, _, _, _ = served
+    # malformed: missing shape
+    status, resp = _post(frontend.url + "/v1/embed",
+                         {"image_b64": "AAAA"})
+    assert status == 400 and resp["error"] == "bad_request"
+    # wrong resolution for this model
+    bad = np.zeros((8, 8, 3), np.uint8)
+    status, resp = _post(frontend.url + "/v1/embed", _b64_body(bad))
+    assert status == 400 and resp["error"] == "bad_request"
+    # byte-count mismatch
+    status, resp = _post(frontend.url + "/v1/embed",
+                         {"image_b64": "AAAA", "shape": [SIZE, SIZE, 3]})
+    assert status == 400
+    # unknown route
+    status, resp = _post(frontend.url + "/v1/nope", {})
+    assert status == 404
+
+
+def test_draining_service_rejects_over_http(tiny_setup):
+    from moco_tpu.serve import EmbedService, ServeFrontend
+
+    engine, _ = tiny_setup
+    service = EmbedService(engine, flush_ms=2.0, max_queue=32,
+                           request_deadline_ms=5_000.0)
+    frontend = ServeFrontend(service, port=0)
+    frontend.start()
+    try:
+        service.drain(timeout_s=5.0)
+        img = _imgs(1)[0]
+        status, resp = _post(frontend.url + "/v1/embed", _b64_body(img))
+        assert status == 503 and resp["error"] == "draining"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(frontend.url + "/healthz", timeout=5)
+        assert exc.value.code == 503
+    finally:
+        frontend.shutdown()
+
+
+def test_serve_telemetry_report_section(served):
+    service, frontend, _, _, events = served
+    for i in range(4):
+        _post(frontend.url + "/v1/embed", _b64_body(_imgs(1, seed=100 + i)[0]))
+    service.registry.flush()
+    records, skipped = telemetry_report.load_events(events)
+    assert skipped == 0
+    summary = telemetry_report.summarize(records)
+    srv = summary["serve"]
+    assert srv["requests"] >= 4 and srv["batches"] >= 1
+    assert "p95" in srv["latency_ms"]
+    rendered = telemetry_report.render(summary)
+    assert "serve:" in rendered and "occupancy mean" in rendered
+    starts = [r for r in records if r.get("kind") == "serve_start"]
+    assert starts and starts[0]["buckets"] == list(BUCKETS)
+
+
+# ---------------------------------------------------------------------------
+# shared checkpoint loader + ServeConfig
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_export(tiny_setup, tmp_path_factory):
+    """The tiny encoder exported in the reference's torchvision dialect —
+    what tools/serve.py actually loads."""
+    import jax
+
+    from moco_tpu.checkpoint import _save_flat, resnet_to_torchvision
+
+    engine, _ = tiny_setup
+    flat = resnet_to_torchvision(
+        jax.tree.map(np.asarray, engine.params),
+        jax.tree.map(np.asarray, engine.batch_stats),
+        prefix="module.encoder_q.",
+    )
+    path = str(tmp_path_factory.mktemp("export") / "tiny.npz")
+    _save_flat(flat, path)
+    return path
+
+
+def test_load_for_inference_roundtrip(tiny_setup, tiny_export):
+    import jax
+
+    from moco_tpu.checkpoint import load_for_inference
+
+    engine, direct = tiny_setup
+    model, params, stats = load_for_inference(
+        tiny_export, "resnet_tiny", image_size=SIZE, cifar_stem=True
+    )
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(engine.params),
+        strict=True,
+    ):
+        assert pa == pb
+        assert np.array_equal(np.asarray(a), np.asarray(b)), pa
+
+
+def test_load_for_inference_rejects_wrong_arch(tiny_export):
+    from moco_tpu.checkpoint import load_for_inference
+
+    with pytest.raises(ValueError, match="surgery mismatch"):
+        load_for_inference(tiny_export, "resnet18", image_size=SIZE,
+                           cifar_stem=True)
+
+
+def test_detect_dialect_table():
+    from moco_tpu.checkpoint import detect_dialect
+
+    assert detect_dialect({"module.encoder_q.conv1.weight": 0}) == \
+        "torchvision_encoder_q"
+    assert detect_dialect({"patch_embed.proj.weight": 0}) == "timm_vit"
+    assert detect_dialect({"backbone/conv1/kernel": 0}) == "v3_tree"
+    with pytest.raises(ValueError, match="no known dialect"):
+        detect_dialect({"mystery.weight": 0})
+
+
+def test_serve_config_validation_and_flags():
+    import argparse
+
+    from moco_tpu.config import ServeConfig, add_config_flags, collect_overrides
+
+    with pytest.raises(ValueError):
+        ServeConfig(buckets=(8, 1))
+    with pytest.raises(ValueError):
+        ServeConfig(max_queue=4)  # smaller than the largest bucket
+    with pytest.raises(ValueError):
+        ServeConfig(request_deadline_ms=0)
+    parser = argparse.ArgumentParser()
+    add_config_flags(parser, ServeConfig)
+    args = parser.parse_args(["--buckets", "1", "4", "16",
+                              "--max-queue", "64", "--flush-ms", "7.5"])
+    config = ServeConfig().replace(**collect_overrides(args, ServeConfig))
+    assert config.buckets == (1, 4, 16)  # retupled, validated
+    assert config.max_queue == 64 and config.flush_ms == 7.5
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5 acceptance: the CPU smoke under real concurrency
+# ---------------------------------------------------------------------------
+
+
+def test_smoke_serve_bench_32_clients(tiny_setup):
+    """serve_bench drives >= 32 concurrent clients for >= 200 requests
+    against the stdlib front end: zero requests lost (every one resolves
+    to a result or a structured rejection), p95 within the configured
+    deadline budget, mean batch occupancy >= 50% under full load, and
+    served embeddings bit-identical to a direct model.apply."""
+    from moco_tpu.serve import EmbeddingEngine, EmbedService, ServeFrontend
+
+    engine0, direct = tiny_setup
+    # smoke-sized ladder: 32 concurrent clients against a max bucket of 32
+    engine = EmbeddingEngine(
+        engine0.model, engine0.params, engine0.batch_stats,
+        image_size=SIZE, buckets=(1, 8, 32),
+    )
+    deadline_ms = 10_000.0
+    service = EmbedService(engine, flush_ms=20.0, max_queue=128,
+                           request_deadline_ms=deadline_ms, cache_mb=0)
+    frontend = ServeFrontend(service, port=0)
+    frontend.start()
+    try:
+        captured: dict[int, list] = {}
+        pool, seed = 16, 3
+        summary = serve_bench.run_load(
+            frontend.url, concurrency=32, total_requests=256,
+            image_size=SIZE, pool=pool, timeout_s=30.0, seed=seed,
+            capture=captured,
+        )
+        stats = service.stats()
+    finally:
+        assert service.drain(timeout_s=30.0)
+        frontend.shutdown()
+    # zero lost: every request resolved (result or structured rejection)
+    assert summary["lost"] == 0, summary["lost_detail"]
+    assert summary["resolved"] == summary["sent"] == 256
+    assert summary["ok"] >= 200
+    # p95 within the deadline budget
+    assert summary["latency_ms"]["p95"] <= deadline_ms
+    # real coalescing under full load
+    assert stats["batches"] >= 1
+    assert stats["occupancy_mean"] >= 0.5, stats
+    # served rows bit-identical to the direct jitted apply on the same
+    # inputs (run_load generates its pool with this seed/size)
+    images = np.random.RandomState(seed).randint(
+        0, 256, (pool, SIZE, SIZE, 3)
+    ).astype(np.uint8)
+    ref = direct(images)
+    assert captured, "no embeddings captured"
+    for k, emb in captured.items():
+        assert np.array_equal(np.asarray(emb, np.float32), ref[k]), k
+
+
+def test_serve_import_is_transitively_train_free():
+    """Lint R6 checks DIRECT imports; this pins the transitive claim: a
+    fresh process importing the serve package AND its sanctioned loader
+    module never pulls the optimizer stack (optax/orbax/train_state)."""
+    import subprocess
+    import sys as _sys
+
+    code = (
+        "import sys\n"
+        "import moco_tpu.serve, moco_tpu.checkpoint\n"
+        "bad = [m for m in sys.modules\n"
+        "       for f in ('optax', 'orbax', 'moco_tpu.train_state',\n"
+        "                 'moco_tpu.train', 'moco_tpu.train_step')\n"
+        "       if m == f or m.startswith(f + '.')]\n"
+        "assert not bad, bad\n"
+    )
+    r = subprocess.run([_sys.executable, "-c", code], capture_output=True,
+                       text=True, cwd=REPO,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_sigterm_drains_cleanly_end_to_end(tiny_export, tmp_path):
+    """tools/serve.py under a real SIGTERM: serve, answer one request,
+    drain on signal, exit EXIT_OK — the wire-level drain contract an
+    orchestrator sees."""
+    import signal
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", MOCO_TPU_NO_CACHE="1")
+    proc = subprocess.Popen(
+        [_sys.executable, "-u", os.path.join(REPO, "tools", "serve.py"),
+         "--pretrained", tiny_export, "--arch", "resnet_tiny",
+         "--image-size", str(SIZE), "--cifar-stem", "true",
+         "--port", "0", "--buckets", "1", "4",
+         "--telemetry-dir", str(tmp_path / "telemetry")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO,
+    )
+    try:
+        url = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if "serving" in line and "http://" in line:
+                url = line.split("http://")[1].split()[0].rstrip("/")
+                break
+        assert url, "server never announced its url"
+        img = _imgs(1, seed=21)[0]
+        status, resp = _post(f"http://{url}/v1/embed", _b64_body(img),
+                             timeout=60.0)
+        assert status == 200 and len(resp["embedding"]) > 0
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == 0, out
+        assert "drained cleanly" in out
+        events = tmp_path / "telemetry" / "events.jsonl"
+        assert events.exists()
+        kinds = [json.loads(ln).get("kind")
+                 for ln in events.read_text().splitlines() if ln.strip()]
+        assert "serve_start" in kinds and "serve" in kinds
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=10)

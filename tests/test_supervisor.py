@@ -63,6 +63,12 @@ def test_classify_named_exit_codes():
     assert classify_exit(45)[0] == "config_error"
     assert classify_exit(2)[0] == "config_error"  # argparse usage error
     assert classify_exit(46)[0] == "data_quality"
+    # ISSUE 5: a bind failure must never restart-loop against the same
+    # occupied socket — fatal class, matching the README table
+    from moco_tpu.resilience.supervisor import FATAL_CLASSES
+
+    assert classify_exit(47)[0] == "serve_bind"
+    assert "serve_bind" in FATAL_CLASSES
     assert classify_exit(1)[0] == CLASS_CRASH
     assert classify_exit(77)[0] == CLASS_CRASH  # unknown positive code
 

@@ -34,6 +34,13 @@ Rules, AST-enforced over every .py file under the package:
       source of truth and a renumbering can never silently fork the
       supervisor from the drivers. (`sys.exit()` bare and
       `sys.exit(EXIT_PREEMPTED)` are fine.)
+  R6  (ISSUE 5) nothing under `moco_tpu/serve/` may import train,
+      train_step, v3_step, train_state, optimizer modules (optax,
+      ops/schedules) — the serving runtime must stay import-light and
+      train-free: an accidental train dependency drags the optimizer
+      stack (and its compile/memory footprint) into every serving
+      process, and a server that CAN touch training state eventually
+      will. Applies to every import in the file, module-level or lazy.
 
 Exit 0 when clean; exit 1 with one `path:line: message` per violation.
 Runs in tier-1 via tests/test_lint_robustness.py (which also holds
@@ -54,6 +61,53 @@ PRINT_ALLOWED = ("utils/logging.py", "utils/meters.py")
 
 # R4: constructors whose result owns background staging threads
 LOADER_FACTORIES = {"Prefetcher", "epoch_loader"}
+
+# R6: modules the serving runtime must never import (directly or lazily).
+# Exact module or any submodule; `from moco_tpu import train` counts too.
+R6_FORBIDDEN = (
+    "moco_tpu.train",
+    "moco_tpu.train_step",
+    "moco_tpu.train_state",
+    "moco_tpu.v3_step",
+    "optax",
+    "moco_tpu.ops.schedules",
+)
+R6_FORBIDDEN_TAILS = {m.rsplit(".", 1)[-1] for m in R6_FORBIDDEN}
+
+
+def _r6_module_forbidden(module: str | None) -> bool:
+    if not module:
+        return False
+    return any(module == f or module.startswith(f + ".") for f in R6_FORBIDDEN)
+
+
+def _r6_violations(tree: ast.AST, path: str) -> list[str]:
+    out = []
+
+    def flag(node, module):
+        out.append(
+            f"{path}:{node.lineno}: serve/ imports {module!r} — the serving "
+            "runtime must stay train-free (lint R6): no train, train_step, "
+            "v3_step, train_state, or optimizer modules"
+        )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if _r6_module_forbidden(alias.name):
+                    flag(node, alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative import inside serve/: always fine
+                continue
+            if _r6_module_forbidden(node.module):
+                flag(node, node.module)
+            elif node.module in ("moco_tpu", "moco_tpu.ops"):
+                for alias in node.names:
+                    full = f"{node.module}.{alias.name}"
+                    if (alias.name in R6_FORBIDDEN_TAILS
+                            and _r6_module_forbidden(full)):
+                        flag(node, full)
+    return out
 
 def _is_exit_call(func: ast.expr) -> bool:
     """Exactly the process-exit spellings: `sys.exit`, `os._exit`, the
@@ -193,6 +247,8 @@ def check_file(path: str) -> list[str]:
         "data/loader.py"
     ):
         out.extend(_r4_check(tree, path))
+    if "moco_tpu/serve/" in os.path.normpath(path).replace(os.sep, "/"):
+        out.extend(_r6_violations(tree, path))
     for node in ast.walk(tree):
         if isinstance(node, ast.Call) and _r5_violation(node):
             out.append(

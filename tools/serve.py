@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Serve MoCo embeddings over HTTP (ISSUE 5).
+
+    python tools/serve.py --pretrained runs/encoder.safetensors \
+        --arch resnet50 --port 8080 --telemetry-dir runs/serve/telemetry
+
+Loads the checkpoint's encoder through the shared surgery loader
+(`checkpoint.load_for_inference` — both dialects), pre-compiles the
+bucket ladder, and mounts the stdlib front end (moco_tpu/serve/http.py):
+POST /v1/embed, POST /v1/knn (with --knn-bank), GET /healthz, /stats.
+
+SIGTERM/SIGINT drains gracefully — in-flight requests complete, new work
+gets a structured 503 `draining` — via the resilience/preemption.py
+handler (second signal: immediate exit, exactly like the train driver).
+
+By default the process compiles into a PER-RUN XLA cache dir
+(utils/cache.per_run_cache_dir): a served process lives under external
+orchestrators that SIGKILL on eviction, and a kill mid-write must not
+poison the shared compile cache (PR 4 finding). An explicit
+MOCO_TPU_CACHE_DIR or MOCO_TPU_NO_CACHE=1 wins.
+
+Exit codes (README table): 0 clean drain · 45 bad config/checkpoint ·
+47 could not bind host:port (see resilience/exitcodes.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from moco_tpu.config import ServeConfig, add_config_flags, collect_overrides  # noqa: E402
+from moco_tpu.resilience.exitcodes import (  # noqa: E402
+    EXIT_CONFIG_ERROR,
+    EXIT_OK,
+    EXIT_SERVE_BIND,
+)
+from moco_tpu.utils.logging import info, log_event  # noqa: E402
+
+
+def build_service(config: ServeConfig):
+    """Engine + service from a ServeConfig (shared with bench/tests)."""
+    import numpy as np
+
+    from moco_tpu.serve import EmbeddingEngine, EmbedService
+
+    engine = EmbeddingEngine.from_checkpoint(
+        config.pretrained,
+        config.arch,
+        image_size=config.image_size,
+        cifar_stem=config.cifar_stem,
+        buckets=config.buckets,
+    )
+    registry = None
+    if config.telemetry_dir:
+        from moco_tpu.telemetry.registry import EVENTS_FILENAME, MetricsRegistry
+
+        registry = MetricsRegistry(
+            os.path.join(config.telemetry_dir, EVENTS_FILENAME)
+        )
+    knn_bank = knn_labels = None
+    if config.knn_bank:
+        bank = np.load(config.knn_bank)
+        if "features" not in bank or "labels" not in bank:
+            raise ValueError(
+                f"--knn-bank {config.knn_bank!r} needs `features` [N,D] "
+                "and `labels` [N] arrays"
+            )
+        knn_bank, knn_labels = bank["features"], bank["labels"]
+    service = EmbedService(
+        engine,
+        flush_ms=config.flush_ms,
+        max_queue=config.max_queue,
+        request_deadline_ms=config.request_deadline_ms,
+        cache_mb=config.embed_cache_mb,
+        registry=registry,
+        snapshot_every=config.snapshot_every,
+        knn_bank=knn_bank,
+        knn_labels=knn_labels,
+        num_classes=config.num_classes,
+        knn_k=config.knn_k,
+        knn_temperature=config.knn_temperature,
+    )
+    return service, registry
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[1],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    add_config_flags(parser, ServeConfig)
+    args = parser.parse_args(argv)
+    try:
+        config = ServeConfig().replace(**collect_overrides(args, ServeConfig))
+        if not config.pretrained:
+            raise ValueError("--pretrained <exported encoder> is required")
+    except ValueError as e:
+        info(f"config error: {e}")
+        return EXIT_CONFIG_ERROR
+
+    from moco_tpu.utils.cache import enable_persistent_cache, per_run_cache_dir
+
+    if os.environ.get("MOCO_TPU_CACHE_DIR") or os.environ.get("MOCO_TPU_NO_CACHE"):
+        enable_persistent_cache()  # explicit operator choice wins
+    else:
+        enable_persistent_cache(per_run_cache_dir(tag="serve"))
+
+    try:
+        service, registry = build_service(config)
+    except (ValueError, OSError) as e:
+        info(f"cannot build the service: {e}")
+        return EXIT_CONFIG_ERROR
+
+    from moco_tpu.serve import ServeFrontend
+
+    try:
+        frontend = ServeFrontend(service, config.host, config.port)
+    except OSError as e:
+        info(f"cannot bind {config.host}:{config.port}: {e}")
+        return EXIT_SERVE_BIND
+
+    from moco_tpu.resilience.preemption import PreemptionHandler
+
+    with PreemptionHandler() as pre:
+        frontend.start()
+        info(
+            f"serving {config.arch} embeddings on {frontend.url} "
+            f"(buckets {list(config.buckets)}, flush {config.flush_ms} ms, "
+            f"queue {config.max_queue}, deadline "
+            f"{config.request_deadline_ms:.0f} ms)"
+        )
+        while not pre.triggered:
+            time.sleep(0.2)
+    log_event(
+        "serve",
+        "signal received: draining — finishing in-flight batches, "
+        "rejecting new work",
+    )
+    service.drain(config.drain_timeout_s)
+    frontend.shutdown()
+    if registry is not None:
+        registry.close()
+    info("drained cleanly")
+    return EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

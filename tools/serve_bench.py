@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the embedding service (ISSUE 5).
+
+    python tools/serve_bench.py --url http://127.0.0.1:8080 \
+        --concurrency 32 --requests 512 --image-size 224
+
+`--concurrency` workers each send their share of `--requests` back to
+back (closed loop — a new request only after the previous one resolved),
+so the offered load is exactly the in-flight concurrency the
+micro-batcher coalesces. EVERY request must end in a result or a
+STRUCTURED rejection (overloaded / deadline_exceeded / draining JSON
+body); anything else — connection error, unstructured 5xx — counts as
+LOST and fails the run. Prints one BENCH-style JSON record: latency
+p50/p95/p99, throughput at the fixed concurrency, shed counts, and the
+server's own /stats fold (mean batch occupancy, compile-bucket ladder).
+
+Pure stdlib + numpy: runs anywhere the server is reachable, no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import http.client
+import json
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+
+STRUCTURED_REJECTIONS = ("overloaded", "deadline_exceeded", "draining")
+
+
+def _percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(0, min(len(ordered) - 1, round(q / 100.0 * (len(ordered) - 1))))
+    return ordered[int(rank)]
+
+
+class _Client:
+    """One persistent keep-alive connection (http.client): a closed-loop
+    worker that reconnects per request measures TCP setup, not serving —
+    and its turnaround jitter smears the very bursts the micro-batcher
+    exists to coalesce. Reconnects transparently when the server (or an
+    idle timeout) dropped the socket."""
+
+    def __init__(self, base_url: str, timeout_s: float):
+        parsed = urllib.parse.urlsplit(base_url)
+        self._host = parsed.hostname
+        self._port = parsed.port or 80
+        self._timeout = timeout_s
+        self._conn: http.client.HTTPConnection | None = None
+
+    def _connect(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self._timeout
+            )
+        return self._conn
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def post_json(self, path: str, payload: bytes):
+        """POST → (status, parsed JSON | None). Non-200 statuses with a
+        JSON body are STRUCTURED answers, not transport failures; one
+        silent retry on a dropped keep-alive socket."""
+        for attempt in (0, 1):
+            conn = self._connect()
+            try:
+                conn.request("POST", path, body=payload,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = resp.read()
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self.close()
+                if attempt:
+                    raise
+                continue
+            try:
+                return resp.status, json.loads(body)
+            except (ValueError, json.JSONDecodeError):
+                return resp.status, None
+        raise OSError("unreachable")  # both attempts raised above
+
+
+def fetch_stats(base_url: str, timeout_s: float = 5.0) -> dict | None:
+    try:
+        with urllib.request.urlopen(base_url.rstrip("/") + "/stats",
+                                    timeout=timeout_s) as resp:
+            return json.loads(resp.read())
+    except (OSError, ValueError, json.JSONDecodeError):
+        return None
+
+
+def run_load(
+    base_url: str,
+    *,
+    concurrency: int = 32,
+    total_requests: int = 512,
+    image_size: int = 224,
+    pool: int = 16,
+    deadline_ms: float = 0.0,
+    timeout_s: float = 30.0,
+    endpoint: str = "/v1/embed",
+    seed: int = 0,
+    capture: dict | None = None,
+) -> dict:
+    """Drive the server; returns the summary dict (see module docstring).
+    `capture`, when given, collects `pool_index -> embedding list` from
+    successful responses so a caller can verify served embeddings against
+    a direct `model.apply` (the CPU-smoke fidelity check)."""
+    rng = np.random.RandomState(seed)
+    images = rng.randint(
+        0, 256, (pool, image_size, image_size, 3)
+    ).astype(np.uint8)
+    payloads = []
+    for im in images:
+        body = {"image_b64": base64.b64encode(im.tobytes()).decode("ascii"),
+                "shape": list(im.shape)}
+        if deadline_ms:
+            body["deadline_ms"] = deadline_ms
+        payloads.append(json.dumps(body).encode("utf-8"))
+
+    lock = threading.Lock()
+    latencies: list[float] = []
+    outcomes: dict[str, int] = {}
+    lost: list[str] = []
+    per = [total_requests // concurrency] * concurrency
+    for i in range(total_requests - sum(per)):
+        per[i] += 1
+    start_gate = threading.Event()
+
+    def worker(wid: int, n: int) -> None:
+        client = _Client(base_url, timeout_s)
+        start_gate.wait()
+        try:
+            for j in range(n):
+                k = (wid * 31 + j * 7) % pool  # deterministic mixed replay
+                t0 = time.monotonic()
+                try:
+                    status, resp = client.post_json(endpoint, payloads[k])
+                except (OSError, TimeoutError, http.client.HTTPException) as e:
+                    with lock:
+                        lost.append(f"worker{wid}: {type(e).__name__}: {e}")
+                    continue
+                dt = time.monotonic() - t0
+                if status == 200 and isinstance(resp, dict):
+                    with lock:
+                        latencies.append(dt)
+                        outcomes["ok"] = outcomes.get("ok", 0) + 1
+                    if capture is not None and "embedding" in resp:
+                        with lock:
+                            capture.setdefault(k, resp["embedding"])
+                elif (isinstance(resp, dict)
+                        and resp.get("error") in STRUCTURED_REJECTIONS):
+                    with lock:
+                        key = str(resp["error"])
+                        outcomes[key] = outcomes.get(key, 0) + 1
+                else:
+                    with lock:
+                        lost.append(
+                            f"worker{wid}: unstructured status {status}: "
+                            f"{str(resp)[:120]}"
+                        )
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(i, per[i]), daemon=True)
+        for i in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    t0 = time.monotonic()
+    start_gate.set()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+
+    resolved = sum(outcomes.values())
+    return {
+        "sent": total_requests,
+        "resolved": resolved,
+        "ok": outcomes.get("ok", 0),
+        "shed": {k: v for k, v in outcomes.items() if k != "ok"},
+        "lost": len(lost),
+        "lost_detail": lost[:8],
+        "concurrency": concurrency,
+        "wall_s": round(wall, 3),
+        "throughput_rps": round(resolved / wall, 1) if wall else 0.0,
+        "latency_ms": {
+            f"p{q}": round(_percentile(latencies, q) * 1e3, 3)
+            for q in (50, 95, 99)
+        },
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    parser.add_argument("--url", required=True,
+                        help="server base url, e.g. http://127.0.0.1:8080")
+    parser.add_argument("--concurrency", type=int, default=32)
+    parser.add_argument("--requests", type=int, default=512)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--pool", type=int, default=16,
+                        help="distinct images replayed (cache-hit mix)")
+    parser.add_argument("--deadline-ms", type=float, default=0.0,
+                        help="per-request deadline forwarded to the server "
+                             "(0 = server default)")
+    parser.add_argument("--timeout-s", type=float, default=30.0)
+    parser.add_argument("--endpoint", default="/v1/embed",
+                        choices=["/v1/embed", "/v1/knn"])
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    summary = run_load(
+        args.url,
+        concurrency=args.concurrency,
+        total_requests=args.requests,
+        image_size=args.image_size,
+        pool=args.pool,
+        deadline_ms=args.deadline_ms,
+        timeout_s=args.timeout_s,
+        endpoint=args.endpoint,
+        seed=args.seed,
+    )
+    record = {
+        "metric": "serve_embed_p95_latency_ms",
+        "value": summary["latency_ms"]["p95"],
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "detail": summary,
+    }
+    stats = fetch_stats(args.url, args.timeout_s)
+    if stats is not None:
+        record["server"] = {
+            k: stats[k]
+            for k in ("batches", "occupancy_mean", "buckets",
+                      "shed_overload", "shed_deadline", "cache")
+            if k in stats
+        }
+    print(json.dumps(record))
+    # zero-requests-lost is the contract; a lost request is a real failure
+    return 1 if summary["lost"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

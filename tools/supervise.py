@@ -73,6 +73,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-force-resume", action="store_true",
                    help="do NOT append `--resume auto` to the child on "
                         "restarts")
+    p.add_argument("--shared-compile-cache", action="store_true",
+                   help="let the child use the SHARED persistent XLA "
+                        "compile cache. Default is a per-run "
+                        "MOCO_TPU_CACHE_DIR (utils/cache.per_run_cache_dir)"
+                        ": a SIGKILL'd child can poison a shared cache "
+                        "into a native-crash loop for every later process "
+                        "(PR 4 finding). An explicit MOCO_TPU_CACHE_DIR / "
+                        "MOCO_TPU_NO_CACHE in the environment also wins")
     p.add_argument("--child-log", default="",
                    help="child stdout/stderr log path (default "
                         "<telemetry-dir>/child.log)")
@@ -89,6 +97,19 @@ def main(argv=None) -> int:
     if not child:
         build_parser().error("no child command given (append `-- python -m "
                              "moco_tpu.train ...`)")
+    if (not args.shared_compile_cache
+            and not os.environ.get("MOCO_TPU_CACHE_DIR")
+            and not os.environ.get("MOCO_TPU_NO_CACHE")):
+        # supervised runs are kill-risk BY DESIGN (hang-kill escalation,
+        # chaos drills): isolate their compile cache so a SIGKILL mid-write
+        # can't poison the shared one for every later process on this host.
+        # Set once for the whole supervision (children inherit the env):
+        # a poisoned per-run dir is contained by the restart budget.
+        from moco_tpu.utils.cache import per_run_cache_dir  # stdlib-only
+
+        os.environ["MOCO_TPU_CACHE_DIR"] = per_run_cache_dir(tag="supervised")
+        info(f"per-run compile cache: {os.environ['MOCO_TPU_CACHE_DIR']} "
+             "(--shared-compile-cache opts out)")
     policy = RestartPolicy(
         max_restarts=args.max_restarts,
         heartbeat_stale_secs=args.heartbeat_stale_secs,

@@ -17,6 +17,10 @@ Renders, from the schema-versioned record stream the driver writes
   - supervisor lifecycle (ISSUE 4): launches/restarts/kills, death
     classifications, final budget state and outcome — the `kind:
     "supervisor"` records tools/supervise.py appends to the same stream
+  - serving (ISSUE 5): request/shed counts, latency p50/p95/p99, batch
+    count and mean bucket occupancy, embedding-cache hit rate — from the
+    cumulative `kind: "serve"` snapshots the embedding service emits
+    (the LAST snapshot summarizes the run)
   - pod-record count and worst cross-host step-time spread
 
 Robustness: unparseable lines (a torn tail from a SIGKILL mid-flush) are
@@ -69,6 +73,7 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
     run_starts = [r for r in records if r.get("kind") == "run_start"]
     run_ends = [r for r in records if r.get("kind") == "run_end"]
     supervisor = [r for r in records if r.get("kind") == "supervisor"]
+    serves = [r for r in records if r.get("kind") == "serve"]
 
     step_s = [r["step_s"] for r in steps if "step_s" in r]
     data_s = [r["data_s"] for r in steps if "data_s" in r]
@@ -180,6 +185,18 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
         if budgets:
             sup["budget_left"] = budgets[-1]
         summary["supervisor"] = sup
+    if serves:
+        # snapshots are cumulative; the last one summarizes the run
+        last = serves[-1]
+        summary["serve"] = {
+            k: last[k]
+            for k in ("requests", "served", "shed_overload", "shed_deadline",
+                      "batch_errors", "batches", "occupancy_mean", "buckets",
+                      "latency_ms", "queue_wait_ms", "cache", "draining",
+                      "uptime_s")
+            if k in last
+        }
+        summary["serve"]["snapshots"] = len(serves)
     if run_ends:
         summary["run_end"] = run_ends[-1]
     return summary
@@ -229,7 +246,9 @@ def render(summary: dict) -> str:
     mfu = summary.get("mfu")
     if mfu:
         lines.append(f"MFU: mean {100 * mfu['mean']:.2f}% · max {100 * mfu['max']:.2f}%")
-    else:
+    elif summary["steps"]:
+        # only a TRAINING stream can owe an MFU; a serve-only events file
+        # (zero step records) has nothing to apologize for
         lines.append(
             "MFU: n/a (no peak-FLOPs basis for this device_kind — re-run "
             "training with peak_flops_per_chip set in the config)"
@@ -290,6 +309,34 @@ def render(summary: dict) -> str:
             lines.append(f"  death classifications: {detail}")
         if "budget_left" in sup:
             lines.append(f"  restart budget left: {sup['budget_left']}")
+    srv = summary.get("serve")
+    if srv:
+        shed = srv.get("shed_overload", 0) + srv.get("shed_deadline", 0)
+        lines.append(
+            f"serve: {srv.get('requests', 0)} requests "
+            f"({srv.get('served', 0)} served, {shed} shed: "
+            f"{srv.get('shed_overload', 0)} overload / "
+            f"{srv.get('shed_deadline', 0)} deadline, "
+            f"{srv.get('batch_errors', 0)} batch error(s))"
+        )
+        lat = srv.get("latency_ms", {})
+        if lat:
+            lines.append(
+                f"  latency: p50 {lat.get('p50', 0):.1f} ms · "
+                f"p95 {lat.get('p95', 0):.1f} ms · p99 {lat.get('p99', 0):.1f} ms"
+            )
+        lines.append(
+            f"  batches: {srv.get('batches', 0)} over buckets "
+            f"{srv.get('buckets', [])} · occupancy mean "
+            f"{100 * srv.get('occupancy_mean', 0):.1f}%"
+        )
+        cache = srv.get("cache")
+        if cache:
+            lines.append(
+                f"  embed cache: {100 * cache.get('hit_rate', 0):.1f}% hit "
+                f"({cache.get('hits', 0)} hit / {cache.get('misses', 0)} "
+                f"miss, {cache.get('entries', 0)} entries)"
+            )
     inc = summary.get("incidents", {})
     if inc:
         detail = ", ".join(f"{k}×{v}" for k, v in sorted(inc.items()))
