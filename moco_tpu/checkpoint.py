@@ -40,6 +40,27 @@ if TYPE_CHECKING:  # annotation-only: see the import note below
 # Orbax save/restore
 # ---------------------------------------------------------------------------
 
+# TrainState pytree dialects (ISSUE 6). The tree Orbax sees is versioned by
+# STRUCTURE, not a number on disk: dialect 2 adds the optional
+# `gradsync/acc` accumulator leaves ([n_dev, *param_shape], per-device
+# error-feedback / local-momentum state for grad_sync quantized/demo).
+# Fused/bucketed runs carry an EMPTY gradsync subtree (zero array leaves).
+# Upgrade (a dialect-1 checkpoint, or one from a different mesh size,
+# restored into a dialect-2 target) is handled by `_restore_step`: the
+# full-target restore fails, the retry with the gradsync field structurally
+# removed succeeds, and the accumulators restart from fresh zeros — always
+# convergence-safe (a zero EF/momentum is the cold-start state) at the cost
+# of one step's worth of re-accumulated compression error. Downgrade (a
+# quantized/demo checkpoint restored by a fused/bucketed run) rides the
+# same shim in reverse: the empty-subtree target ignores the on-disk
+# accumulators via the stripped retry.
+TRAIN_STATE_DIALECTS = {
+    1: "pre-gradsync TrainState (PRs 1-5): no gradsync leaves",
+    2: "gradsync accumulators: optional gradsync/acc [n_dev, ...] leaves "
+       "(grad_sync quantized/demo; empty tree for fused/bucketed)",
+}
+TRAIN_STATE_DIALECT = 2
+
 
 def checkpoint_manager(directory: str, max_to_keep: int = 3) -> "ocp.CheckpointManager":
     import orbax.checkpoint as ocp
@@ -181,7 +202,65 @@ def _restore_step(
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
 
         target = jax.tree.map(to_abstract, target)
-    restored = mgr.restore(step, args=ocp.args.StandardRestore(target))
+    try:
+        restored = mgr.restore(step, args=ocp.args.StandardRestore(target))
+    except Exception:
+        # dialect shim (TRAIN_STATE_DIALECTS): the target's `gradsync`
+        # subtree and the checkpoint's disagree whenever the checkpoint is
+        # dialect 1 (no such key on disk), was written under a different
+        # grad_sync mode (present vs empty), or on a different mesh size
+        # (leading [n_dev] axis mismatch). Retry with a target whose
+        # gradsync subtree is rebuilt FROM THE CHECKPOINT'S OWN metadata —
+        # structurally exact, so a healthy checkpoint restores — then throw
+        # the on-disk accumulators away and keep the caller's fresh ones
+        # (zeros: the convergence-safe cold-start state). A retry failure
+        # is genuine corruption and propagates to the walk-back.
+        import dataclasses
+
+        if not hasattr(abstract_state, "gradsync"):
+            raise
+        md = mgr.item_metadata(step)
+        md_gs = md.get("gradsync") if isinstance(md, dict) else None
+
+        def _sig(tree):
+            return [
+                (jax.tree_util.keystr(p), tuple(leaf.shape))
+                for p, leaf in jax.tree_util.tree_leaves_with_path(tree)
+            ]
+
+        if _sig(md_gs) == _sig(getattr(target, "gradsync")):
+            # the checkpoint's gradsync subtree matches the target's — the
+            # failure is NOT a dialect/mode/mesh mismatch (transient read,
+            # real corruption): re-raise rather than silently zeroing valid
+            # on-disk accumulators under a misleading dialect event
+            raise
+        stripped = {
+            f.name: getattr(target, f.name)
+            for f in dataclasses.fields(target)
+            if f.name != "gradsync"
+        }
+        if md_gs is not None and jax.tree.leaves(md_gs):
+            def from_md(m):
+                if sharding is not None:
+                    return jax.ShapeDtypeStruct(m.shape, m.dtype,
+                                                sharding=sharding)
+                return jax.ShapeDtypeStruct(m.shape, m.dtype)
+
+            stripped["gradsync"] = jax.tree.map(from_md, md_gs)
+        restored_dict = mgr.restore(
+            step, args=ocp.args.StandardRestore(stripped))
+        from moco_tpu.utils.logging import log_event
+
+        log_event(
+            "ckpt-dialect",
+            f"step {step} has no gradsync accumulators matching this run "
+            "(older dialect, different grad_sync mode, or different mesh "
+            "size) — restored without them; error-feedback/momentum state "
+            "restarts from zeros",
+        )
+        restored = type(abstract_state)(
+            **{k: v for k, v in restored_dict.items() if k != "gradsync"},
+            gradsync=abstract_state.gradsync)
     return _rekey(restored)
 
 

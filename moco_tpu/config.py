@@ -40,11 +40,41 @@ class PretrainConfig:
                                       # the data axis (HBM/N footprint, one
                                       # all-gather of updates per step;
                                       # identical numerics — parallel/zero)
-    grad_allreduce_dtype: str = "float32"  # "bfloat16" halves the grad
-                                      # all-reduce's ICI bytes (quantized
-                                      # collective, EQuARX-style; the master
-                                      # update still runs in f32). Off by
-                                      # default — the reference reduces f32
+    # gradient sync (ISSUE 6; parallel/gradsync.py — see README "Gradient
+    # sync modes" for the mode table and convergence caveats)
+    grad_sync: str = "fused"          # "fused" (exact DP, one tree pmean —
+                                      # the seed program, bitwise) |
+                                      # "bucketed" (per-bucket psums chained
+                                      # with optimization_barrier: reduce
+                                      # overlaps backprop, bitwise-equal
+                                      # numerics) | "quantized" (int8/bf16
+                                      # compress→psum→dequant per bucket +
+                                      # per-device error feedback) | "demo"
+                                      # (DeMo-style local momentum, top-k
+                                      # sparse sync at a cadence)
+    grad_sync_bucket_mb: float = 4.0  # bucketed/quantized: target bucket
+                                      # payload (MiB of wire bytes per
+                                      # all-reduce issue)
+    grad_sync_quant_dtype: str = "int8"  # quantized wire dtype: "int8"
+                                      # (shared-scale symmetric, int32
+                                      # carrier) | "bfloat16"
+    grad_sync_cadence: int = 1        # demo: sync every N steps (off-steps
+                                      # carry no gradient payload — only
+                                      # the constant probe-scalar psum)
+    grad_sync_topk: float = 0.01      # demo: fraction of each leaf's
+                                      # momentum synced per sync step
+    grad_sync_demo_beta: float = 0.9  # demo: local momentum decay
+    grad_allreduce_dtype: str = "float32"  # fused/bucketed wire-dtype
+                                      # policy: "bfloat16" halves the grad
+                                      # all-reduce's ICI bytes (EQuARX-style
+                                      # in its simplest lossy form, NO error
+                                      # feedback — grad_sync="quantized" is
+                                      # the EF-corrected version; the master
+                                      # update still runs in f32). Per-leaf
+                                      # policy: float leaves reduce in bf16
+                                      # and cast back to their OWN dtype,
+                                      # integer leaves are summed exactly,
+                                      # never cast (gradsync.leaf_wire_dtype)
     fused_bn_conv: bool = False       # interior bn→relu→conv passes through
                                       # Pallas fused kernels on TPU: the
                                       # Bottleneck 1x1 tail + stride-1 3x3
@@ -199,6 +229,35 @@ class PretrainConfig:
         if self.input_cache_mb < 0:
             raise ValueError(
                 f"input_cache_mb must be >= 0, got {self.input_cache_mb}"
+            )
+        # grad-sync knobs (ISSUE 6): literals kept in sync with
+        # parallel/gradsync.GRAD_SYNC_MODES — config must stay importable
+        # without jax (the serve/stdlib processes)
+        if self.grad_sync not in ("fused", "bucketed", "quantized", "demo"):
+            raise ValueError(
+                f"unknown grad_sync {self.grad_sync!r}; choose from "
+                "fused/bucketed/quantized/demo"
+            )
+        if self.grad_sync_bucket_mb <= 0:
+            raise ValueError(
+                f"grad_sync_bucket_mb must be > 0, got {self.grad_sync_bucket_mb}"
+            )
+        if self.grad_sync_quant_dtype not in ("int8", "bfloat16"):
+            raise ValueError(
+                f"unknown grad_sync_quant_dtype {self.grad_sync_quant_dtype!r}"
+            )
+        if self.grad_sync_cadence < 1:
+            raise ValueError(
+                f"grad_sync_cadence must be >= 1, got {self.grad_sync_cadence}"
+            )
+        if not 0.0 < self.grad_sync_topk <= 1.0:
+            raise ValueError(
+                f"grad_sync_topk must be in (0, 1], got {self.grad_sync_topk}"
+            )
+        if not 0.0 <= self.grad_sync_demo_beta < 1.0:
+            raise ValueError(
+                f"grad_sync_demo_beta must be in [0, 1), got "
+                f"{self.grad_sync_demo_beta}"
             )
 
     def replace(self, **kw) -> "PretrainConfig":

@@ -9,7 +9,10 @@ from moco_tpu.parallel.collectives import (
     all_gather_batch,
     batch_shuffle,
     batch_unshuffle,
+    chained_psum,
+    quantized_psum_mean,
 )
+from moco_tpu.parallel.gradsync import GRAD_SYNC_MODES, GradSync
 
 __all__ = [
     "DATA_AXIS",
@@ -20,4 +23,8 @@ __all__ = [
     "all_gather_batch",
     "batch_shuffle",
     "batch_unshuffle",
+    "chained_psum",
+    "quantized_psum_mean",
+    "GRAD_SYNC_MODES",
+    "GradSync",
 ]

@@ -84,6 +84,89 @@ def batch_unshuffle(x: jax.Array, perm: jax.Array, axis_name: str) -> jax.Array:
     return jnp.take(x_all, local_idx, axis=0)
 
 
+def chained_psum(flats: list[jax.Array], axis_name: str) -> list[jax.Array]:
+    """Per-bucket psums chained through `optimization_barrier` (ISSUE 6).
+
+    Each element of `flats` is one flat gradient bucket. A plain loop of
+    psums leaves XLA free to merge them back into one fused end-of-step
+    all-reduce — exactly the serialization bucketing exists to break. The
+    barrier ties bucket i+1's INPUT to bucket i's OUTPUT, so the reduces
+    issue as a deterministic pipeline: bucket i can be on the wire while
+    the backward that produces bucket i+1 is still running (DeAR,
+    PAPERS.md). On builds whose barrier is identity (utils/compat.py) the
+    numerics are unchanged — only the scheduling hint is lost."""
+    from moco_tpu.utils.compat import optimization_barrier
+
+    out = []
+    prev = None
+    for flat in flats:
+        if prev is not None:
+            flat, prev = optimization_barrier((flat, prev))
+        summed = lax.psum(flat, axis_name)
+        out.append(summed)
+        prev = summed
+    return out
+
+
+def quantized_psum_mean(
+    segments: list[jax.Array], axis_name: str, n: int, wire_dtype: str
+) -> tuple[list[jax.Array], list[jax.Array]]:
+    """Compress→psum→dequant one bucket of flat f32 segments (one segment
+    per gradient leaf); returns `(means, errors)` aligned with `segments`.
+
+    `wire_dtype="int8"`: symmetric int8 with PER-SEGMENT scales, shared
+    across devices via ONE `pmax` of the stacked per-segment absmaxes (a
+    single tiny vector reduce per bucket). The scale must follow the leaf,
+    not the bucket: a multi-MiB bucket spans layers whose gradient
+    magnitudes differ by orders of magnitude, and one bucket-wide scale
+    would quantize the small-magnitude layers to all-zeros on the wire
+    every step — a hidden sync starvation error feedback only undoes one
+    quantum at a time. Shared scales keep the dequantized mean
+    bit-identical across devices (the DP-safety invariant). The whole
+    bucket still rides ONE concatenated psum, on an int32 carrier: summing
+    n int8 values overflows int8 for n >= 2, and XLA exposes no
+    in-collective requantization (EQuARX does this inside the ring; the
+    int8 PAYLOAD plus one f32 scale per leaf is what the byte accounting
+    counts).
+
+    `wire_dtype="bfloat16"`: cast→psum→f32, the legacy grad_allreduce path
+    — but returning the local cast error so callers can carry error
+    feedback, which the legacy path never had.
+
+    `errors` are the LOCAL quantization residuals (input minus what the
+    wire carried for this device) — the error-feedback accumulator
+    re-injects them into the next step's gradient."""
+    if wire_dtype == "int8":
+        absmax = lax.pmax(
+            jnp.stack([jnp.max(jnp.abs(s)) for s in segments]), axis_name
+        )
+        scales = jnp.maximum(absmax, jnp.float32(1e-30)) / 127.0
+        qs = [
+            jnp.clip(jnp.round(s / scales[i]), -127, 127).astype(jnp.int8)
+            for i, s in enumerate(segments)
+        ]
+        flat = jnp.concatenate(qs) if len(qs) > 1 else qs[0]
+        summed = lax.psum(flat.astype(jnp.int32), axis_name)
+        means, errs, off = [], [], 0
+        for i, (s, q) in enumerate(zip(segments, qs)):
+            seg = summed[off:off + s.size]
+            off += s.size
+            means.append(seg.astype(jnp.float32) * scales[i] / n)
+            errs.append(s - q.astype(jnp.float32) * scales[i])
+        return means, errs
+    if wire_dtype == "bfloat16":
+        qs = [s.astype(jnp.bfloat16) for s in segments]
+        flat = jnp.concatenate(qs) if len(qs) > 1 else qs[0]
+        summed = lax.psum(flat, axis_name).astype(jnp.float32)
+        means, errs, off = [], [], 0
+        for s, q in zip(segments, qs):
+            means.append(summed[off:off + s.size] / n)
+            off += s.size
+            errs.append(s - q.astype(jnp.float32))
+        return means, errs
+    raise ValueError(f"unknown quantized wire dtype {wire_dtype!r}")
+
+
 def ring_shuffle(x: jax.Array, axis_name: str, inverse: bool = False) -> jax.Array:
     """Cheaper ShuffleBN variant: HALF-SHARD ring roll via two `ppermute`s.
 

@@ -64,3 +64,32 @@ def shard_opt_state(opt_state, mesh):
         opt_state,
         opt_state_shardings(opt_state, mesh),
     )
+
+
+def pdevice_state_shardings(tree, mesh):
+    """Shardings for PER-DEVICE state carried in the replicated TrainState
+    (ISSUE 6: gradsync error-feedback / local-momentum accumulators): every
+    leaf has a leading device axis of size `mesh.size`, split over the data
+    axis so each device holds exactly its own `[1, ...]` slice — the same
+    footprint-per-chip argument as the ZeRO layout above, except here the
+    split axis is semantic (slice i IS device i's state), not just a
+    partitioning choice."""
+    replicated = NamedSharding(mesh, P())
+    sharded = NamedSharding(mesh, P(DATA_AXIS))
+
+    def spec(leaf):
+        shape = getattr(leaf, "shape", ())
+        return sharded if shape and shape[0] == mesh.size else replicated
+
+    return jax.tree.map(spec, tree)
+
+
+def shard_pdevice_state(tree, mesh):
+    """Place per-device-state leaves on their owning devices (see
+    `pdevice_state_shardings`); applied at creation and re-applied after a
+    resume, which restores the leaves replicated."""
+    return jax.tree.map(
+        lambda leaf, s: jax.device_put(leaf, s),
+        tree,
+        pdevice_state_shardings(tree, mesh),
+    )

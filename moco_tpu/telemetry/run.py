@@ -70,6 +70,7 @@ class RunTelemetry:
         self._mfu_hist = self.registry.histogram("mfu")
         self._hbm_gauge = self.registry.gauge("hbm_peak_bytes")
         self._incidents = self.registry.counter("incidents")
+        self._grad_sync: dict | None = None
         self._closed = False
         mlog.add_event_sink(self._on_event)
         self.registry.emit(
@@ -99,6 +100,15 @@ class RunTelemetry:
     def event(self, kind: str, **fields) -> None:
         """Structured non-incident event (e.g. knn_eval, epoch_summary)."""
         self.registry.emit("event", event=kind, **fields)
+
+    def set_grad_sync(self, info: dict) -> None:
+        """Record the gradient-sync plan (ISSUE 6): mode, knobs, analytic
+        sync-bytes/step/device. Emitted once as a routine `grad_sync` event;
+        the compressed modes (quantized/demo) also stamp the dict onto step
+        records at the sampling stride, so a stream tail is self-describing
+        about the bytes its step times were measured under."""
+        self._grad_sync = dict(info)
+        self.registry.emit("event", event="grad_sync", **info)
 
     def phase_beat(self, phase: str, step: int) -> None:
         """Forced heartbeat declaring a known-long non-step phase (the
@@ -138,6 +148,9 @@ class RunTelemetry:
                 # queue depth / cache hit rate / staged-batch latency /
                 # worker busy fraction, cumulative for the run so far
                 record["input"] = self.input_stats.snapshot()
+            if self._grad_sync and self._grad_sync.get("mode") in (
+                    "quantized", "demo"):
+                record["grad_sync"] = self._grad_sync
         self.pod.update(
             step_s=phases["step_s"], data_s=phases["data_s"],
             imgs_per_sec=rolling, incidents=self._incidents.value,

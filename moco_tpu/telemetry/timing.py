@@ -15,6 +15,16 @@ A training step's wall time decomposes into:
               bottleneck" — and the fence is what a comm/compute-overlap
               PR will move, so it must stay OFF the steady-state path
               (stride=0 never fences; off-stride steps stay fully async).
+  comm_s    — gradient-sync tail (ISSUE 6), measured only on the SAME fenced
+              samples: the step emits two probe scalars (gradsync's
+              grads-ready psum and a reading of the reduced grads) and the
+              fence drains them in order — comm_s is drain(reduced) −
+              drain(grads-ready), i.e. how long the step sat between "local
+              grads exist" and "the sync is visible". Honest caveat: on
+              backends that materialize all program outputs atomically
+              (CPU, and the relay on scalar transfers) both probes drain
+              together and comm_s reads ~0 — the analytic sync-bytes/step
+              in the `grad_sync` records is the backend-independent signal.
   step_s    — the whole iteration (data_s + host_s + meters + everything);
               on fenced steps it includes the fence wait.
 
@@ -42,12 +52,14 @@ class StepPhaseTimer:
         self._t_data = None
         self._t_dispatch = None
         self._device_s = None
+        self._comm_s = None
 
     def epoch_start(self) -> None:
         now = time.perf_counter()
         self._t_iter = now
         self._t_data = self._t_dispatch = None
         self._device_s = None
+        self._comm_s = None
 
     def mark_data(self) -> None:
         self._t_data = time.perf_counter()
@@ -55,7 +67,8 @@ class StepPhaseTimer:
     def mark_dispatch(self) -> None:
         self._t_dispatch = time.perf_counter()
 
-    def maybe_fence(self, step: int, sync_obj) -> float | None:
+    def maybe_fence(self, step: int, sync_obj, comm_pre=None,
+                    comm_post=None) -> float | None:
         """Stride-gated device fence; returns device_s on sampled steps.
 
         `sync_obj` is any step output (the loss array); draining it fences
@@ -65,11 +78,24 @@ class StepPhaseTimer:
         experimental axon PJRT relay (moco_tpu/utils/benchkit.py) — a
         fence that returns early would record a near-zero device phase and
         tell the exact lie this telemetry exists to prevent.
-        `block_until_ready` remains the fallback for non-scalar outputs."""
+        `block_until_ready` remains the fallback for non-scalar outputs.
+
+        `comm_pre`/`comm_post` are the gradient-sync probe scalars (ISSUE
+        6): when both are present on a fenced step they are drained FIRST,
+        in order, and their gap is recorded as the `comm_s` phase — see the
+        module docstring for what that number can and cannot claim."""
         if self.stride <= 0 or step % self.stride != 0:
             return None
         if self._t_dispatch is None:  # fence without a dispatch mark
             return None
+        if comm_pre is not None and comm_post is not None:
+            try:
+                float(comm_pre)
+                t_pre = time.perf_counter()
+                float(comm_post)
+                self._comm_s = max(time.perf_counter() - t_pre, 0.0)
+            except (TypeError, ValueError):
+                self._comm_s = None  # non-scalar probes: no comm sample
         try:
             float(sync_obj)
         except (TypeError, ValueError):
@@ -94,7 +120,10 @@ class StepPhaseTimer:
         }
         if self._device_s is not None:
             phases["device_s"] = self._device_s
+        if self._comm_s is not None:
+            phases["comm_s"] = self._comm_s
         self._t_iter = now
         self._t_data = self._t_dispatch = None
         self._device_s = None
+        self._comm_s = None
         return phases

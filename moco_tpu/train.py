@@ -378,7 +378,18 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
             config.num_negatives,
             config.embed_dim,
         )
+    # gradient-sync accumulators (ISSUE 6): attached BEFORE any resume so
+    # the restore target carries the dialect-2 leaves (quantized/demo);
+    # fused/bucketed attach an empty tree
+    from moco_tpu.parallel.gradsync import GradSync
+
+    gradsync = GradSync(config, mesh.size)
+    state = gradsync.attach(state, mesh)
     step_fn = build_train_step(config, model, tx, mesh, steps_per_epoch, sched)
+    if telemetry is not None:
+        # static comm facts for the record stream: mode, knobs, analytic
+        # per-device sync payload (bytes/step) — rendered by telemetry_report
+        telemetry.set_grad_sync(gradsync.describe(state.params_q))
 
     mgr = checkpoint_manager(config.ckpt_dir) if config.ckpt_dir else None
     if mgr is not None and config.resume:
@@ -389,6 +400,11 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
         from moco_tpu.parallel.mesh import replicated
 
         state = maybe_resume(mgr, state, config.resume, sharding=replicated(mesh))
+        if gradsync.needs_state:
+            # re-place the per-device accumulators (the restore above lands
+            # them replicated) — mirrors the ZeRO re-shard below
+            state = state.replace(
+                gradsync=gradsync.place_state(state.gradsync, mesh))
     if config.zero_sharding:
         # ZeRO-1 (after any resume, so the placement survives it): optimizer
         # state sharded over the data axis; jit propagates the committed
@@ -573,11 +589,19 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                     profiler.maybe_toggle(global_step)
                     state, metrics = fused_step(state, imgs, extents, global_step)
                     global_step += 1
+                    # comm-phase probes (ISSUE 6): device scalars marking
+                    # grads-ready / grads-reduced, popped so meters and the
+                    # scalar writer never see them
+                    gs_pre = metrics.pop("gs_comm_pre", None)
+                    gs_post = metrics.pop("gs_comm_post", None)
                     if telemetry is not None:
                         telemetry.timer.mark_dispatch()
                         # stride-gated device fence: off-stride steps stay
                         # fully async (the overhead contract)
-                        telemetry.timer.maybe_fence(global_step, metrics["loss"])
+                        telemetry.timer.maybe_fence(
+                            global_step, metrics["loss"],
+                            comm_pre=gs_pre, comm_post=gs_post,
+                        )
                     if plan is not None and plan.maybe_nan(global_step):
                         # emulate a real divergence end-to-end: the NaN flows
                         # through the same metrics dict the sentinel/meters see

@@ -12,6 +12,7 @@ reference's torch.save of the full state_dict.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
 
 import flax.struct
@@ -33,6 +34,15 @@ class TrainState:
     queue: jax.Array | None         # [K, dim] negative keys (None for v3)
     queue_ptr: jax.Array | None     # int32 ring pointer (None for v3)
     rng: jax.Array                  # replicated base PRNG key (model-side RNG)
+    # gradient-sync accumulators (ISSUE 6; parallel/gradsync.py): `{}` for
+    # the stateless modes (fused/bucketed — dialect-1-compatible on disk),
+    # else {"acc": <params-shaped tree>} of PER-DEVICE leaves with a leading
+    # [n_dev] axis sharded over the data mesh — the quantized mode's
+    # error-feedback residual / the demo mode's local momentum. Carried in
+    # the state so checkpoints resume compression exactly (dialect 2,
+    # checkpoint.TRAIN_STATE_DIALECTS; ties the checkpoint to the mesh size
+    # — restore falls back to fresh zeros on mismatch).
+    gradsync: Any = dataclasses.field(default_factory=dict)
 
 
 def create_train_state(

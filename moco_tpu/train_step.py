@@ -17,10 +17,14 @@ moco_tpu/parallel/collectives.py); outside, XLA's partitioner keeps them
 replicated for free — and the whole thing still compiles to one program.
 
 Per-step collectives (cf. SURVEY §3.1): 2 all-gathers of the local key batch
-(shuffle-in, unshuffle) + 1 of the 128-d keys (enqueue) + 1 grad psum. The
-reference's rank-0 permutation broadcast and DDP buffer re-broadcast are
-GONE — replaced by deterministic shared-RNG permutation and replicated
-arithmetic (zero communication).
+(shuffle-in, unshuffle) + 1 of the 128-d keys (enqueue) + the gradient sync
+(ISSUE 6: `parallel/gradsync.py` — one fused pmean, per-bucket chained
+psums, quantized reduce with error feedback, or DeMo-style sparse sync,
+selected by `config.grad_sync`) + 1 tiny scalar psum (the comm-phase
+grads-ready probe the telemetry fence drains). The reference's rank-0
+permutation broadcast and DDP buffer re-broadcast are GONE — replaced by
+deterministic shared-RNG permutation and replicated arithmetic (zero
+communication).
 """
 
 from __future__ import annotations
@@ -48,24 +52,6 @@ from moco_tpu.ops.queue import dequeue_and_enqueue
 from moco_tpu.parallel.collectives import batch_shuffle, batch_unshuffle
 from moco_tpu.parallel.mesh import DATA_AXIS
 from moco_tpu.train_state import TrainState
-
-
-def _pmean_grads(grads, allreduce_dtype: str):
-    """Gradient all-reduce over the data axis, optionally in bfloat16.
-
-    `"bfloat16"` casts each gradient leaf down before the `pmean` and back
-    up after — half the ICI bytes per step (the quantized-collective idea of
-    EQuARX/DynamiQ, PAPERS.md, in its simplest lossy form). The optimizer
-    math stays f32 on the master params; the quantization error (~2^-8
-    relative per leaf) is the same order as bf16 compute noise. Default off:
-    the reference's DDP reduces f32 gradients."""
-    if allreduce_dtype == "float32":
-        return lax.pmean(grads, DATA_AXIS)
-    if allreduce_dtype != "bfloat16":
-        raise ValueError(f"unknown grad_allreduce_dtype {allreduce_dtype!r}")
-    down = jax.tree.map(lambda g: g.astype(jnp.bfloat16), grads)
-    reduced = lax.pmean(down, DATA_AXIS)
-    return jax.tree.map(lambda g: g.astype(jnp.float32), reduced)
 
 
 def build_encoder(config: PretrainConfig):
@@ -214,8 +200,14 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
     total_steps = config.epochs * steps_per_epoch
     if sched is None:
         sched = lr_schedule(config, steps_per_epoch)
+    # gradient sync strategy (ISSUE 6): the ONLY place grads meet a
+    # collective — lint R7 forbids pmean/psum on grads outside parallel/
+    from moco_tpu.parallel.gradsync import GradSync
 
-    def spmd_region(params_q, params_k, stats_q, stats_k, queue, im_q, im_k, key):
+    gradsync = GradSync(config, mesh.size)
+
+    def spmd_region(params_q, params_k, stats_q, stats_k, queue, gs_state,
+                    im_q, im_k, key, step):
         # --- ShuffleBN: decorrelate per-device BN groups on the key path ---
         # "permute" = the reference-faithful all-gather + shared-RNG global
         # permutation; "ring" = half-shard roll (2 ppermutes, partial
@@ -258,8 +250,9 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
         (loss, (new_stats_q, logits, labels)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params_q)
-        # DDP-equivalent gradient all-reduce (mean over the data axis)
-        grads = _pmean_grads(grads, config.grad_allreduce_dtype)
+        # DDP-equivalent gradient sync (mean over the data axis) through the
+        # configured strategy; demo's replicated merge happens outside
+        payload, gs_new, gs_probe = gradsync.region_reduce(grads, gs_state, step)
         # Running BN stats: averaged across devices so replicas stay
         # bit-identical (replaces DDP broadcast_buffers, SURVEY §2.2 note).
         new_stats_q = lax.pmean(new_stats_q, DATA_AXIS)
@@ -275,13 +268,15 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
             {"loss": loss, "acc1": acc1, "acc5": acc5, "pos_sim": pos_sim},
             DATA_AXIS,
         )
-        return grads, k, new_stats_q, new_stats_k, metrics
+        return payload, gs_new, gs_probe, k, new_stats_q, new_stats_k, metrics
 
     region = shard_map(
         spmd_region,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
-        out_specs=(P(), P(DATA_AXIS), P(), P(), P()),
+        in_specs=(P(), P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(), P()),
+        out_specs=(gradsync.payload_specs(P), P(DATA_AXIS), P(), P(DATA_AXIS),
+                   P(), P(), P()),
     )
 
     def train_step(state: TrainState, im_q, im_k):
@@ -297,16 +292,22 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
         # costing ~20 ms/step of copy stalls on the v5e (measured r2: the
         # update phase alone is 24.8 ms interleaved vs 5.0 ms fenced)
         params_k = optimization_barrier(params_k)
-        grads, k_global, stats_q, stats_k, metrics = region(
+        payload, gs_new, gs_probe, k_global, stats_q, stats_k, metrics = region(
             state.params_q,
             params_k,
             state.batch_stats_q,
             state.batch_stats_k,
             state.queue,
+            state.gradsync,
             im_q,
             im_k,
             shuffle_key,
+            state.step,
         )
+        # demo's sparse merge (a no-op for the dense modes) lives at the
+        # outer jit level: replicated values derived from gathered ones
+        # cannot be typed replicated inside the region (collectives.py note)
+        grads = gradsync.finalize(payload, state.step)
         grads = optimization_barrier(grads)  # fence bwd from the update phase
         updates, opt_state = tx.update(grads, state.opt_state, state.params_q)
         params_q = optax.apply_updates(state.params_q, updates)
@@ -314,7 +315,12 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
         queue, queue_ptr = dequeue_and_enqueue(
             state.queue, state.queue_ptr, k_global
         )
-        metrics = dict(metrics, lr=sched(state.step), queue_ptr=queue_ptr)
+        metrics = dict(
+            metrics, lr=sched(state.step), queue_ptr=queue_ptr,
+            # comm-phase probes (telemetry/timing.py): drained in order by
+            # the stride-gated fence, popped by the driver before display
+            gs_comm_pre=gs_probe, gs_comm_post=gradsync.probe_post(grads),
+        )
         new_state = state.replace(
             step=state.step + 1,
             params_q=params_q,
@@ -324,6 +330,7 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
             opt_state=opt_state,
             queue=queue,
             queue_ptr=queue_ptr,
+            gradsync=gs_new,
         )
         return new_state, metrics
 

@@ -67,6 +67,11 @@ def build_v2_fused_step(config, mesh, *, steps_per_epoch: int = 1000,
             config.num_negatives,
             config.embed_dim,
         )
+    # gradient-sync accumulators (ISSUE 6), exactly as the driver attaches
+    # them — a quantized/demo bench without the state would crash at trace
+    from moco_tpu.parallel.gradsync import GradSync
+
+    state = GradSync(config, n_chips).attach(state, mesh)
     step_fn = build_train_step(config, model, tx, mesh, steps_per_epoch, sched)
     # the SAME variant->aug selection as the train driver (v1 presets get
     # the v1 recipe, not a silently-substituted v2 stack — review, r5)

@@ -105,12 +105,14 @@ def build_v3_train_step(
     config: PretrainConfig, model: V3Model, tx, mesh, steps_per_epoch: int, sched=None
 ):
     """Jitted `(state, x1, x2) -> (state', metrics)`, state donated."""
-    from moco_tpu.train_step import _pmean_grads, lr_schedule
+    from moco_tpu.parallel.gradsync import GradSync
+    from moco_tpu.train_step import lr_schedule
 
     temperature = config.temperature
     total_steps = config.epochs * steps_per_epoch
     if sched is None:
         sched = lr_schedule(config, steps_per_epoch)
+    gradsync = GradSync(config, mesh.size)
 
     def apply(params, stats, x, predict):
         out, mut = model.apply(
@@ -122,7 +124,8 @@ def build_v3_train_step(
         )
         return l2_normalize(out), mut["batch_stats"]
 
-    def spmd_region(params_q, params_k, stats_q, stats_k, x1, x2):
+    def spmd_region(params_q, params_k, stats_q, stats_k, gs_state, x1, x2,
+                    step):
         # momentum-encoder keys for both crops (running stats chained through
         # the two forwards, as two sequential reference forward calls would)
         k1, stats_k = apply(params_k, stats_k, x1, predict=False)
@@ -140,7 +143,7 @@ def build_v3_train_step(
         (loss, (new_stats_q, q1)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params_q)
-        grads = _pmean_grads(grads, config.grad_allreduce_dtype)
+        payload, gs_new, gs_probe = gradsync.region_reduce(grads, gs_state, step)
         new_stats_q = lax.pmean(new_stats_q, DATA_AXIS)
         new_stats_k = lax.pmean(stats_k, DATA_AXIS)
         # monitoring: in-batch top-1 for the q1·k2 direction
@@ -155,13 +158,14 @@ def build_v3_train_step(
         metrics = lax.pmean(
             {"loss": loss, "acc1": acc1, "pos_sim": pos_sim}, DATA_AXIS
         )
-        return grads, new_stats_q, new_stats_k, metrics
+        return payload, gs_new, gs_probe, new_stats_q, new_stats_k, metrics
 
     region = shard_map(
         spmd_region,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(), P(), P(), P()),
+        in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P()),
+        out_specs=(gradsync.payload_specs(P), P(DATA_AXIS), P(), P(), P(), P()),
     )
 
     def train_step(state: TrainState, x1, x2):
@@ -170,12 +174,17 @@ def build_v3_train_step(
         else:
             m = config.momentum_ema
         params_k = ema_update(state.params_k, encoder_subtree(state.params_q), m)
-        grads, stats_q, stats_k, metrics = region(
-            state.params_q, params_k, state.batch_stats_q, state.batch_stats_k, x1, x2
+        payload, gs_new, gs_probe, stats_q, stats_k, metrics = region(
+            state.params_q, params_k, state.batch_stats_q, state.batch_stats_k,
+            state.gradsync, x1, x2, state.step,
         )
+        grads = gradsync.finalize(payload, state.step)
         updates, opt_state = tx.update(grads, state.opt_state, state.params_q)
         params_q = optax.apply_updates(state.params_q, updates)
-        metrics = dict(metrics, lr=sched(state.step), momentum=m)
+        metrics = dict(
+            metrics, lr=sched(state.step), momentum=m,
+            gs_comm_pre=gs_probe, gs_comm_post=gradsync.probe_post(grads),
+        )
         return (
             state.replace(
                 step=state.step + 1,
@@ -184,6 +193,7 @@ def build_v3_train_step(
                 batch_stats_q=stats_q,
                 batch_stats_k=stats_k,
                 opt_state=opt_state,
+                gradsync=gs_new,
             ),
             metrics,
         )

@@ -209,6 +209,49 @@ def test_r6_holds_for_the_real_serve_package():
     assert r6 == [], r6
 
 
+def test_r7_detects_grad_collective_outside_parallel(tmp_path):
+    """R7 (ISSUE 6): an inline pmean/psum on grads outside parallel/
+    silently reverts the step to the fused reduce — flagged; collectives on
+    non-gradient values stay legal."""
+    path = tmp_path / "moco_tpu" / "stepish.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(
+        "from jax import lax\n"
+        "def region(grads, new_stats_q, metrics, g_grads):\n"
+        "    grads = lax.pmean(grads, 'data')\n"          # violation
+        "    out = psum(g_grads, 'data')\n"               # violation (bare)
+        "    new_stats_q = lax.pmean(new_stats_q, 'data')\n"  # legal
+        "    metrics = lax.pmean(metrics, 'data')\n"          # legal
+        "    one = lax.psum(1, 'data')\n"                     # legal
+        "    return grads, out, new_stats_q, metrics, one\n"
+    )
+    found = lint.check_file(str(path))
+    assert len(found) == 2
+    assert all("gradsync API" in v for v in found)
+    assert ":3:" in found[0] and ":4:" in found[1]
+
+
+def test_r7_allows_grad_collectives_under_parallel(tmp_path):
+    """The gradsync layer itself IS the sanctioned home for gradient
+    collectives."""
+    path = tmp_path / "moco_tpu" / "parallel" / "gradsyncish.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(
+        "from jax import lax\n"
+        "def reduce(grads):\n"
+        "    return lax.pmean(grads, 'data')\n"
+    )
+    assert lint.check_file(str(path)) == []
+
+
+def test_r7_holds_for_the_real_step_builders():
+    """Tier-1 gate: train_step/v3_step route grads through gradsync."""
+    for rel in ("moco_tpu/train_step.py", "moco_tpu/v3_step.py"):
+        r7 = [v for v in lint.check_file(os.path.join(REPO, rel))
+              if "gradsync API" in v]
+        assert r7 == [], r7
+
+
 def test_r4_holds_for_bench_and_package_call_sites():
     """The real construction sites (train driver, lincls, bench.py — the
     latter outside the package tree, held to R4 here) stay clean."""

@@ -34,6 +34,14 @@ Rules, AST-enforced over every .py file under the package:
       source of truth and a renumbering can never silently fork the
       supervisor from the drivers. (`sys.exit()` bare and
       `sys.exit(EXIT_PREEMPTED)` are fine.)
+  R7  (ISSUE 6) gradient collectives — `pmean`/`psum` whose operand names
+      mention gradients — may only appear under `moco_tpu/parallel/`. The
+      step builders (train_step/v3_step) must route gradients through the
+      gradsync API: an inline `lax.pmean(grads, ...)` silently reverts the
+      step to the fused end-of-step reduce, bypassing the configured
+      bucketing/quantization/sparsification AND the comm telemetry that
+      measures it. Collectives on non-gradient values (BN stats, metrics)
+      stay legal anywhere.
   R6  (ISSUE 5) nothing under `moco_tpu/serve/` may import train,
       train_step, v3_step, train_state, optimizer modules (optax,
       ops/schedules) — the serving runtime must stay import-light and
@@ -108,6 +116,24 @@ def _r6_violations(tree: ast.AST, path: str) -> list[str]:
                             and _r6_module_forbidden(full)):
                         flag(node, full)
     return out
+
+def _r7_violation(node: ast.Call) -> bool:
+    """True for `pmean(...)`/`psum(...)` (bare or attribute call, e.g.
+    `lax.pmean`) whose FIRST argument is a name or attribute mentioning
+    gradients (`grads`, `grad_tree`, `g_grads`, ...). Deliberately
+    name-based: the lint guards the obvious regression (pasting the old
+    `_pmean_grads` body back into a step builder), not adversarial
+    renaming."""
+    name = _call_name(node.func)
+    if name not in ("pmean", "psum") or not node.args:
+        return False
+    first = node.args[0]
+    if isinstance(first, ast.Name):
+        return "grad" in first.id.lower()
+    if isinstance(first, ast.Attribute):
+        return "grad" in first.attr.lower()
+    return False
+
 
 def _is_exit_call(func: ast.expr) -> bool:
     """Exactly the process-exit spellings: `sys.exit`, `os._exit`, the
@@ -249,7 +275,20 @@ def check_file(path: str) -> list[str]:
         out.extend(_r4_check(tree, path))
     if "moco_tpu/serve/" in os.path.normpath(path).replace(os.sep, "/"):
         out.extend(_r6_violations(tree, path))
+    # R7: gradient collectives live in parallel/ only (the gradsync API)
+    grad_collectives_allowed = (
+        "moco_tpu/parallel/" in os.path.normpath(path).replace(os.sep, "/")
+    )
     for node in ast.walk(tree):
+        if (not grad_collectives_allowed
+                and isinstance(node, ast.Call) and _r7_violation(node)):
+            out.append(
+                f"{path}:{node.lineno}: gradient collective outside "
+                "moco_tpu/parallel/ — route grads through the gradsync API "
+                "(parallel/gradsync.GradSync); an inline pmean/psum on grads "
+                "bypasses the configured sync mode and its telemetry"
+            )
+            continue
         if isinstance(node, ast.Call) and _r5_violation(node):
             out.append(
                 f"{path}:{node.lineno}: numeric-literal process exit — use "

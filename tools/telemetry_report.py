@@ -8,6 +8,9 @@ Renders, from the schema-versioned record stream the driver writes
 (moco_tpu/telemetry/registry.py):
 
   - step-time p50/p95/p99 (ms) + the data/host/device phase split
+  - gradient sync (ISSUE 6): mode + analytic sync-bytes/step/device from
+    the `grad_sync` records, comm-phase share from the fenced `comm_s`
+    samples (grads-ready → reduced)
   - MFU (mean/max) and the peak-FLOPs assumption it was judged against
   - throughput (rolling at end-of-run, cumulative mean)
   - HBM high-water mark + host-RSS high-water
@@ -90,7 +93,7 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
     # incidents = events that signal trouble; routine markers the driver
     # emits on purpose (epoch/eval bookkeeping) are reported separately,
     # matching the driver's own `incidents` counter (log_event-routed only)
-    routine = {"epoch_summary", "knn_eval"}
+    routine = {"epoch_summary", "knn_eval", "grad_sync"}
     incidents = {k: v for k, v in events_by_kind.items() if k not in routine}
 
     summary: dict = {
@@ -128,6 +131,29 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
             "p50": round(_percentile(device_s, 50) * 1e3, 3),
             "max": round(max(device_s) * 1e3, 3),
         }
+    # gradient sync (ISSUE 6): comm-phase share over the fenced samples
+    # (grads-ready → reduced, from the same strided fence as device_s) plus
+    # the static plan (mode + analytic sync-bytes/step/device) — from the
+    # one routine `grad_sync` event or the stamped step records
+    comm = [(r["comm_s"], r["step_s"]) for r in steps
+            if "comm_s" in r and r.get("step_s")]
+    if comm:
+        shares = [c / s for c, s in comm]
+        summary["comm"] = {
+            "samples": len(comm),
+            "p50_ms": round(_percentile([c for c, _ in comm], 50) * 1e3, 3),
+            "max_ms": round(max(c for c, _ in comm) * 1e3, 3),
+            "share_mean": round(sum(shares) / len(shares), 4),
+        }
+    gs_events = [e for e in events if e.get("event") == "grad_sync"]
+    gs_steps = [r["grad_sync"] for r in steps
+                if isinstance(r.get("grad_sync"), dict)]
+    if gs_events or gs_steps:
+        last = gs_steps[-1] if gs_steps else {
+            k: v for k, v in gs_events[-1].items()
+            if k not in ("kind", "event", "t", "schema")
+        }
+        summary["grad_sync"] = last
     if mfu:
         summary["mfu"] = {
             "mean": round(sum(mfu) / len(mfu), 5),
@@ -242,6 +268,29 @@ def render(summary: dict) -> str:
         lines.append(
             f"device drain (fenced, {dev['samples']} samples): "
             f"p50 {dev['p50']:.1f} ms · max {dev['max']:.1f} ms"
+        )
+    gs = summary.get("grad_sync")
+    if gs:
+        extras = []
+        if "bucket_mb" in gs:
+            extras.append(f"{gs['bucket_mb']} MiB × {gs.get('buckets', '?')} "
+                          "buckets")
+        if "quant_dtype" in gs:
+            extras.append(str(gs["quant_dtype"]))
+        if "cadence" in gs:
+            extras.append(f"top-{100 * gs.get('topk', 0):.1f}% every "
+                          f"{gs['cadence']} step(s)")
+        lines.append(
+            f"grad sync: {gs.get('mode', '?')} · "
+            f"{gs.get('sync_bytes_per_step', 0) / 2**20:.2f} MiB/step/device"
+            + (f" ({', '.join(extras)})" if extras else "")
+        )
+    comm = summary.get("comm")
+    if comm:
+        lines.append(
+            f"  comm phase (fenced, {comm['samples']} samples): "
+            f"p50 {comm['p50_ms']:.1f} ms · max {comm['max_ms']:.1f} ms · "
+            f"share {100 * comm['share_mean']:.1f}%"
         )
     mfu = summary.get("mfu")
     if mfu:
