@@ -1,3 +1,8 @@
+# NO eager schedules re-export here: importing ANY ops submodule executes
+# this __init__, so `from moco_tpu.ops.knn import knn_predict` on the serve
+# path would drag the optimizer-side schedule module into every serving
+# process (import-boundary lint R11, generalizing R6). Schedule users
+# (train_step, the drivers) import moco_tpu.ops.schedules directly.
 from moco_tpu.ops.queue import init_queue, dequeue_and_enqueue
 from moco_tpu.ops.ema import ema_update, momentum_schedule
 from moco_tpu.ops.losses import (
@@ -7,7 +12,6 @@ from moco_tpu.ops.losses import (
     contrastive_accuracy,
     v3_contrastive_loss,
 )
-from moco_tpu.ops.schedules import cosine_lr, step_lr, warmup_cosine_lr
 
 __all__ = [
     "init_queue",
@@ -19,7 +23,4 @@ __all__ = [
     "softmax_cross_entropy",
     "contrastive_accuracy",
     "v3_contrastive_loss",
-    "cosine_lr",
-    "step_lr",
-    "warmup_cosine_lr",
 ]

@@ -40,13 +40,18 @@ class StepWatchdog:
         # re-arm threshold: after flagging once, flag again only after a
         # FURTHER full interval of silence (one line per interval, not per poll)
         self._warn_after = self.interval
+        # guards the re-arm state written from both sides (lint R10): an
+        # unlocked `beat()` racing `_watch`'s `+=` could lose the re-arm
+        # and either re-flag every poll or go silent for an extra interval
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def beat(self, step: int) -> None:
-        self._step = int(step)
-        self._last = time.monotonic()
-        self._warn_after = self.interval
+        with self._lock:
+            self._step = int(step)
+            self._last = time.monotonic()
+            self._warn_after = self.interval
 
     @contextlib.contextmanager
     def suspended(self):
@@ -54,27 +59,33 @@ class StepWatchdog:
         blocking save): a flag fired there is a false positive that trains
         operators to ignore the real ones. Re-arms fresh on exit. Safe when
         the watchdog is disabled; nests."""
-        self._suspend += 1
+        with self._lock:
+            self._suspend += 1
         try:
             yield
         finally:
-            self._suspend -= 1
-            self._last = time.monotonic()
-            self._warn_after = self.interval
+            with self._lock:
+                self._suspend -= 1
+                self._last = time.monotonic()
+                self._warn_after = self.interval
 
     def _watch(self) -> None:
         poll = max(self.interval / 4.0, 0.01)
         while not self._stop.wait(poll):
-            if self._suspend:
-                continue
-            gap = time.monotonic() - self._last
-            if gap > self._warn_after:
-                self.stalls += 1
-                self._warn_after += self.interval
+            with self._lock:
+                if self._suspend:
+                    continue
+                gap = time.monotonic() - self._last
+                flag = gap > self._warn_after
+                if flag:
+                    self.stalls += 1
+                    self._warn_after += self.interval
+                    step = self._step
+            if flag:
                 log_event(
                     "watchdog",
                     f"no step completed in {gap:.1f}s (last completed step "
-                    f"{self._step}, threshold {self.interval:.1f}s) — "
+                    f"{step}, threshold {self.interval:.1f}s) — "
                     "possible hang (stuck collective / wedged input pipeline)",
                 )
 

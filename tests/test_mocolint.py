@@ -1,0 +1,532 @@
+"""tools/mocolint in tier-1: the pluggable analysis engine (ISSUE 7).
+
+Covers: per-rule positive+negative fixtures for the new rules R8-R11,
+suppression + unused-suppression reporting, baseline round-trip, the
+--json schema, and the repo gate — `python -m tools.mocolint moco_tpu
+tools bench.py` must be CLEAN (zero unsuppressed findings) and fast
+(single parse per file; the whole-repo budget is 5 s).
+
+R1-R7 behavior parity is pinned by tests/test_lint_robustness.py, which
+runs unmodified against the legacy shim.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.mocolint import baseline as baseline_mod  # noqa: E402
+from tools.mocolint.config import DEFAULT_CONFIG  # noqa: E402
+from tools.mocolint.engine import Engine, module_name_for  # noqa: E402
+
+
+def run_on(tmp_path, rel, body, select=None):
+    """Write `body` at tmp_path/rel and run the default config on it."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(body)
+    return Engine(DEFAULT_CONFIG, select=select).run([str(path)]).findings
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- R8: host syncs in traced step code -------------------------------------
+
+R8_POSITIVE = """\
+import jax
+import numpy as np
+
+def build_step(tx):
+    def train_step(state, batch):
+        loss = compute(state, batch)
+        metrics = {"loss": loss.item()}          # sync
+        arr = np.asarray(loss)                   # host materialization
+        scale = float(loss)                      # scalar coercion
+        jax.block_until_ready(loss)              # fence
+        if batch.shape[0] > 4:                   # shape branch
+            loss = loss * 2
+        return state, metrics
+    return jax.jit(train_step, donate_argnums=(0,))
+"""
+
+
+def test_r8_flags_host_syncs_inside_traced_functions(tmp_path):
+    found = run_on(tmp_path, "moco_tpu/train_step.py", R8_POSITIVE,
+                   select=("R8",))
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 5, msgs
+    assert ".item()" in msgs and "np.asarray" in msgs
+    assert "`float(...)`" in msgs and "block_until_ready" in msgs
+    assert "branch on `.shape`" in msgs
+
+
+def test_r8_ignores_host_code_outside_traced_functions(tmp_path):
+    # the SAME calls in build-time (host) code are legal: R8 is scoped to
+    # traced bodies, not to the module
+    body = """\
+import jax
+import numpy as np
+
+def build_step(cfg, arrs):
+    dim = int(np.asarray(arrs[0]).shape[-1])     # host setup: fine
+    jax.block_until_ready(arrs)                  # host setup: fine
+    def train_step(state, batch):
+        return state
+    return jax.jit(train_step)
+"""
+    assert run_on(tmp_path, "moco_tpu/train_step.py", body,
+                  select=("R8",)) == []
+
+
+def test_r8_sees_through_shard_map_and_nesting(tmp_path):
+    body = """\
+from moco_tpu.utils.compat import shard_map
+
+def build(mesh):
+    def region(x):
+        def inner(y):
+            return y.item()                      # nested: still traced
+        return inner(x)
+    return shard_map(region, mesh=mesh, in_specs=None, out_specs=None)
+"""
+    found = run_on(tmp_path, "moco_tpu/v3_step.py", body, select=("R8",))
+    assert len(found) == 1 and ".item()" in found[0].message
+
+
+def test_r8_scoped_to_step_builder_modules(tmp_path):
+    # a traced .item() in a NON-step-builder module is not R8's business
+    assert run_on(tmp_path, "moco_tpu/evals/lincls.py", R8_POSITIVE,
+                  select=("R8",)) == []
+
+
+def test_r8_clean_on_real_step_builders():
+    for rel in ("moco_tpu/train_step.py", "moco_tpu/v3_step.py",
+                "moco_tpu/serve/engine.py"):
+        found = Engine(DEFAULT_CONFIG, select=("R8",)).run(
+            [os.path.join(REPO, rel)]).findings
+        assert found == [], [f.human() for f in found]
+
+
+# -- R9: Python-side nondeterminism -----------------------------------------
+
+def test_r9_flags_global_rng_and_wall_clock(tmp_path):
+    body = """\
+import random
+import time
+import numpy as np
+
+def pick(xs):
+    k = random.choice(xs)                        # global RNG
+    jitter = np.random.rand()                    # numpy global RNG
+    stamp = time.time()                          # wall clock as a value
+    return k, jitter, stamp
+"""
+    found = run_on(tmp_path, "moco_tpu/data/augment.py", body,
+                   select=("R9",))
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 3, msgs
+    assert "random.choice" in msgs and "np.random.rand" in msgs
+    assert "time.time()" in msgs
+
+
+def test_r9_allows_seeded_generators_and_perf_counter(tmp_path):
+    body = """\
+import time
+import numpy as np
+
+def shuffle(n, seed, epoch):
+    rng = np.random.RandomState(seed * 100003 + epoch)
+    g = np.random.default_rng(seed)
+    t0 = time.perf_counter()                     # telemetry: fine
+    return rng.permutation(n), g, time.perf_counter() - t0
+"""
+    assert run_on(tmp_path, "moco_tpu/data/loader.py", body,
+                  select=("R9",)) == []
+
+
+def test_r9_keyword_seed_counts_as_seeded(tmp_path):
+    body = """\
+import numpy as np
+
+def make(seed):
+    return np.random.default_rng(seed=seed), np.random.RandomState(seed=seed)
+"""
+    assert run_on(tmp_path, "moco_tpu/data/loader.py", body,
+                  select=("R9",)) == []
+
+
+def test_r9_flags_set_iteration(tmp_path):
+    body = """\
+def order(tags):
+    out = []
+    for t in set(tags):                          # hash-order iteration
+        out.append(t)
+    return out, [x for x in {1, 2, 3}]           # set-literal comprehension
+"""
+    found = run_on(tmp_path, "moco_tpu/ops/queue.py", body, select=("R9",))
+    assert len(found) == 2
+    assert all("iteration over a set" in f.message for f in found)
+
+
+def test_r9_scoped_to_bit_identity_modules(tmp_path):
+    # the supervisor's restart jitter legitimately uses random: out of scope
+    body = "import random\ndelay = random.uniform(0, 1)\n"
+    assert run_on(tmp_path, "moco_tpu/resilience/supervisor.py", body,
+                  select=("R9",)) == []
+
+
+# -- R10: thread-safety audit ------------------------------------------------
+
+R10_RACY = """\
+import threading
+
+class Racy:
+    def __init__(self):
+        self.count = 0                           # init: before the thread
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._work, daemon=True)
+        self._t.start()
+
+    def _work(self):
+        while True:
+            self.count += 1                      # worker write, no lock
+
+    def reset(self):
+        self.count = 0                           # public write, no lock
+"""
+
+
+def test_r10_flags_unlocked_shared_writes(tmp_path):
+    found = run_on(tmp_path, "mod.py", R10_RACY, select=("R10",))
+    assert len(found) == 2
+    assert {"_work", "reset"} <= {
+        m for f in found for m in ("_work", "reset") if m in f.message
+    }
+
+
+def test_r10_accepts_locked_writes_and_worker_only_state(tmp_path):
+    body = """\
+import threading
+
+class Locked:
+    def __init__(self):
+        self.count = 0
+        self.progress = 0
+        self._cond = threading.Condition()
+        self._t = threading.Thread(target=self._work, daemon=True)
+        self._t.start()
+
+    def _work(self):
+        with self._cond:
+            self.count += 1                      # locked: fine
+        self.progress += 1                       # worker-ONLY attr: fine
+
+    def reset(self):
+        with self._cond:
+            self.count = 0                       # locked: fine
+"""
+    assert run_on(tmp_path, "mod.py", body, select=("R10",)) == []
+
+
+def test_r10_tracks_worker_reachability_through_helpers(tmp_path):
+    body = """\
+import threading
+
+class Indirect:
+    def __init__(self):
+        self.n = 0
+        self._lock = threading.Lock()
+        threading.Thread(target=self._loop).start()
+
+    def _loop(self):
+        self._step()                             # helper reached from worker
+
+    def _step(self):
+        self.n += 1                              # effectively a worker write
+
+    def reset(self):
+        self.n = 0
+"""
+    found = run_on(tmp_path, "mod.py", body, select=("R10",))
+    assert len(found) == 2, [f.message for f in found]
+
+
+def test_r10_ignores_classes_without_threads(tmp_path):
+    body = """\
+class Plain:
+    def a(self):
+        self.x = 1
+
+    def b(self):
+        self.x = 2
+"""
+    assert run_on(tmp_path, "mod.py", body, select=("R10",)) == []
+
+
+def test_r10_clean_on_real_threaded_classes():
+    for rel in ("moco_tpu/serve/batcher.py", "moco_tpu/data/loader.py",
+                "moco_tpu/resilience/watchdog.py", "moco_tpu/serve/http.py"):
+        found = Engine(DEFAULT_CONFIG, select=("R10",)).run(
+            [os.path.join(REPO, rel)]).findings
+        assert found == [], [f.human() for f in found]
+
+
+# -- R11: import boundaries --------------------------------------------------
+
+def test_r11_transitive_serve_chain(tmp_path):
+    (tmp_path / "moco_tpu" / "serve").mkdir(parents=True)
+    (tmp_path / "moco_tpu" / "__init__.py").write_text("")
+    (tmp_path / "moco_tpu" / "serve" / "__init__.py").write_text("")
+    (tmp_path / "moco_tpu" / "helper.py").write_text("import optax\n")
+    (tmp_path / "moco_tpu" / "serve" / "svc.py").write_text(
+        "from moco_tpu.helper import thing\n"
+    )
+    found = Engine(DEFAULT_CONFIG, select=("R11",)).run(
+        [str(tmp_path / "moco_tpu")]).findings
+    assert len(found) == 1
+    assert "import chain reaches 'optax'" in found[0].message
+    assert found[0].path.endswith("svc.py")
+
+
+def test_r11_stdlib_only_supervisor(tmp_path):
+    found = run_on(tmp_path, "moco_tpu/resilience/supervisor.py",
+                   "import os\nimport numpy as np\n", select=("R11",))
+    assert len(found) == 1
+    assert "stdlib-only" in found[0].message and "numpy" in found[0].message
+
+
+def test_r11_stdlib_only_transitive_through_package(tmp_path):
+    (tmp_path / "moco_tpu" / "resilience").mkdir(parents=True)
+    (tmp_path / "moco_tpu" / "__init__.py").write_text("")
+    (tmp_path / "moco_tpu" / "resilience" / "__init__.py").write_text("")
+    (tmp_path / "moco_tpu" / "heavy.py").write_text("import jax\n")
+    (tmp_path / "moco_tpu" / "resilience" / "supervisor.py").write_text(
+        "from moco_tpu.heavy import thing\n"
+    )
+    found = Engine(DEFAULT_CONFIG, select=("R11",)).run(
+        [str(tmp_path / "moco_tpu")]).findings
+    assert len(found) == 1
+    assert "non-stdlib 'jax'" in found[0].message
+
+
+def test_r11_orbax_must_stay_lazy(tmp_path):
+    body = """\
+import orbax.checkpoint as ocp                   # module level: flagged
+
+def save(tree):
+    import orbax.checkpoint as lazy_ocp          # lazy: fine
+    return lazy_ocp, ocp
+"""
+    found = run_on(tmp_path, "moco_tpu/checkpoint.py", body,
+                   select=("R11",))
+    assert len(found) == 1 and found[0].line == 1
+    assert "imported lazily" in found[0].message
+
+
+def test_r11_type_checking_imports_are_exempt(tmp_path):
+    body = """\
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    import orbax.checkpoint as ocp               # annotations only: fine
+"""
+    assert run_on(tmp_path, "moco_tpu/checkpoint.py", body,
+                  select=("R11",)) == []
+
+
+def test_r11_clean_on_real_boundary_files():
+    paths = [os.path.join(REPO, p) for p in
+             ("moco_tpu", "tools/supervise.py")]
+    found = Engine(DEFAULT_CONFIG, select=("R11",)).run(paths).findings
+    assert found == [], [f.human() for f in found]
+
+
+# -- suppression -------------------------------------------------------------
+
+def test_suppression_trailing_and_standalone(tmp_path):
+    body = """\
+def f():
+    try:
+        pass
+    except:  # mocolint: disable=R1 -- fixture exercises the syntax
+        pass
+    # mocolint: disable=R1
+    try:
+        pass
+    except Exception:
+        raise
+"""
+    # NB the second suppression covers line 7 (`try:`) where nothing
+    # fires -> reported as unused
+    result = Engine(DEFAULT_CONFIG, select=("R1",)).run(
+        [_write(tmp_path, "mod.py", body)])
+    assert rules_of(result.findings) == ["SUP"]
+    assert len(result.suppressed) == 1
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    body = """\
+try:
+    pass
+except:  # mocolint: disable=R3 -- wrong id: does NOT cover R1
+    pass
+"""
+    result = Engine(DEFAULT_CONFIG, select=("R1", "R3")).run(
+        [_write(tmp_path, "mod.py", body)])
+    assert rules_of(result.findings) == ["R1", "SUP"]
+
+
+def test_select_subset_does_not_flag_other_rules_suppressions(tmp_path):
+    """A valid R8 suppression must not read as 'unused' just because a
+    --select run never gave R8 the chance to fire."""
+    body = """\
+import jax
+
+def build():
+    def step(x):
+        return x.item()  # mocolint: disable=R8 -- fixture: deliberate
+    return jax.jit(step)
+"""
+    path = _write(tmp_path, "moco_tpu/train_step.py", body)
+    full = Engine(DEFAULT_CONFIG).run([path])
+    assert full.findings == [] and len(full.suppressed) == 1
+    subset = Engine(DEFAULT_CONFIG, select=("R1",)).run([path])
+    assert subset.findings == []
+
+
+def test_suppression_all_and_docstring_mentions_ignored(tmp_path):
+    body = '''\
+"""Docs quoting the syntax: # mocolint: disable=R1 — not a suppression."""
+try:
+    pass
+except:  # mocolint: disable=all -- chaos fixture
+    pass
+'''
+    result = Engine(DEFAULT_CONFIG, select=("R1",)).run(
+        [_write(tmp_path, "mod.py", body)])
+    assert result.findings == [] and len(result.suppressed) == 1
+
+
+def _write(tmp_path, rel, body):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(body)
+    return str(path)
+
+
+# -- baseline ----------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    dirty = _write(tmp_path, "mod.py", "try:\n    x=1\nexcept:\n    pass\n")
+    engine = Engine(DEFAULT_CONFIG, select=("R1",))
+    first = engine.run([dirty])
+    assert rules_of(first.findings) == ["R1"]
+    bl = tmp_path / "baseline.json"
+    baseline_mod.write(str(bl), first.findings)
+    second = engine.run([dirty], baseline_path=str(bl))
+    assert second.findings == [] and rules_of(second.baselined) == ["R1"]
+
+
+def test_baseline_catches_new_occurrences(tmp_path):
+    dirty = _write(tmp_path, "mod.py", "try:\n    x=1\nexcept:\n    pass\n")
+    engine = Engine(DEFAULT_CONFIG, select=("R1",))
+    bl = tmp_path / "baseline.json"
+    baseline_mod.write(str(bl), engine.run([dirty]).findings)
+    # a SECOND identical violation exceeds the grandfathered count
+    (tmp_path / "mod.py").write_text(
+        "try:\n    x=1\nexcept:\n    pass\n"
+        "try:\n    y=2\nexcept:\n    pass\n"
+    )
+    result = engine.run([dirty], baseline_path=str(bl))
+    assert rules_of(result.findings) == ["R1"]
+    assert rules_of(result.baselined) == ["R1"]
+
+
+def test_overlapping_paths_scan_each_file_once(tmp_path):
+    """A dir plus a file inside it must not double findings — doubled
+    occurrences would exceed their baseline budget."""
+    dirty = _write(tmp_path, "pkg/mod.py",
+                   "try:\n    x=1\nexcept:\n    pass\n")
+    engine = Engine(DEFAULT_CONFIG, select=("R1",))
+    result = engine.run([str(tmp_path / "pkg"), dirty, dirty])
+    assert result.files_scanned == 1
+    assert rules_of(result.findings) == ["R1"]
+    bl = tmp_path / "baseline.json"
+    baseline_mod.write(str(bl), result.findings)
+    again = engine.run([str(tmp_path / "pkg"), dirty],
+                       baseline_path=str(bl))
+    assert again.findings == []
+
+
+def test_baseline_survives_path_respelling(tmp_path, monkeypatch):
+    """`moco_tpu` vs `./moco_tpu` vs absolute must fingerprint the same:
+    a committed baseline can't depend on how the CI invocation spells
+    the root."""
+    monkeypatch.chdir(tmp_path)
+    dirty = _write(tmp_path, "pkg/mod.py",
+                   "try:\n    x=1\nexcept:\n    pass\n")
+    engine = Engine(DEFAULT_CONFIG, select=("R1",))
+    bl = tmp_path / "baseline.json"
+    baseline_mod.write(str(bl), engine.run(["pkg"]).findings)
+    for spelling in ("pkg", "./pkg", dirty, os.path.join(".", "pkg")):
+        result = engine.run([spelling], baseline_path=str(bl))
+        assert result.findings == [], (spelling,
+                                       [f.human() for f in result.findings])
+
+
+def test_committed_baseline_is_empty():
+    """The repo carries NO grandfathered findings: the baseline file
+    exists to exercise the mechanism, not to hide debt."""
+    assert baseline_mod.load(
+        os.path.join(REPO, "tools", "mocolint", "baseline.json")) == {}
+
+
+# -- CLI: json schema + the tier-1 repo gate ---------------------------------
+
+def _cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.mocolint", *args],
+        capture_output=True, text=True, cwd=cwd,
+    )
+
+
+def test_cli_json_schema(tmp_path):
+    dirty = _write(tmp_path, "mod.py", "try:\n    x=1\nexcept:\n    pass\n")
+    proc = _cli(["--json", "--select", "R1", dirty])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == 1 and payload["tool"] == "mocolint"
+    assert payload["files_scanned"] == 1
+    (finding,) = payload["findings"]
+    assert set(finding) == {"path", "line", "col", "rule", "severity",
+                            "message"}
+    assert finding["rule"] == "R1" and finding["line"] == 3
+
+
+def test_cli_unknown_rule_is_usage_error():
+    assert _cli(["--select", "R99", "moco_tpu"]).returncode == 2
+
+
+@pytest.mark.parametrize("extra", [[], ["--baseline",
+                                        "tools/mocolint/baseline.json"]])
+def test_repo_gate_zero_unsuppressed_findings(extra):
+    """THE tier-1 gate: the whole repo is clean under every rule, with
+    and without the committed (empty) baseline, inside the ~5 s budget
+    the single-parse engine promises."""
+    t0 = time.monotonic()
+    proc = _cli([*extra, "moco_tpu", "tools", "bench.py"])
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "mocolint clean" in proc.stdout
+    # generous CI headroom over the observed ~1.2 s; the contract is
+    # "one parse per file", not a loaded-runner microbenchmark
+    assert elapsed < 20.0, f"mocolint took {elapsed:.1f}s"

@@ -1,0 +1,2 @@
+# Marks tools/ as a package so `python -m tools.mocolint` works from the
+# repo root. The scripts in this directory remain directly runnable.

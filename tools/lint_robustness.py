@@ -1,345 +1,60 @@
 #!/usr/bin/env python
-"""Forbid silent exception swallowing in moco_tpu/ (ISSUE 1 tooling).
+"""Legacy CLI/API shim over the mocolint engine (ISSUE 7).
 
-The fault-tolerance subsystem only works if faults are VISIBLE: a bare
-`except:` (which eats KeyboardInterrupt/SystemExit and hides the
-preemption path) or an `except Exception: pass` (which discards the very
-errors the retry/rollback machinery routes on) would quietly defeat it.
+The seven robustness rules (R1–R7) that used to live here as one
+monolithic walker are now plugin rules in `tools/mocolint/rules/` —
+see that package for the engine (single parse per file, shared visitor
+dispatch, inline suppression, baselines, `--json`) and the four newer
+rules R8–R11. This file keeps the original surface alive unchanged:
 
-Rules, AST-enforced over every .py file under the package:
+  - `check_file(path)` / `check_tree(root)` return the historical
+    `"path:line: message"` strings (no rule ids), sorted by
+    path/line/rule, running exactly rules R1–R7 with their historical
+    scoping (`LEGACY_CONFIG`);
+  - the CLI exits 0 when clean, 1 with one line per violation plus a
+    count — the contract tests/test_lint_robustness.py pins.
 
-  R1  no bare `except:` handlers;
-  R2  no handler over `Exception`/`BaseException` whose body is only
-      `pass`/`...` — swallowing EVERYTHING silently is never a policy.
-      Narrow named exceptions (`except (AttributeError, ValueError): pass`)
-      stay legal: deliberately ignoring a specific, expected failure is a
-      policy the type spells out.
-  R3  (ISSUE 2) no bare `print(...)` outside utils/logging.py and
-      utils/meters.py — an event printed anywhere else bypasses the
-      structured channel (`log_event` → telemetry events.jsonl) and the
-      one sanctioned plain-line path (`logging.info`), so an external
-      monitor can never consume it.
-  R4  (ISSUE 3) every `Prefetcher(...)` / `epoch_loader(...)` construction
-      bound to a name must have a `finally` in the same function calling
-      `<name>.close()` or `<name>.close_quietly()` — the staging threads
-      and `depth` device batches leak otherwise (the class of leak ISSUE 1
-      fixed by hand at every call site, now enforced). A construction
-      returned directly (`return Prefetcher(...)`) is the factory pattern
-      and exempt: the caller owns the close.
-  R5  (ISSUE 4) no numeric-literal process exits — `sys.exit(43)`,
-      `exit(1)`, `os._exit(2)`, `raise SystemExit(3)` — anywhere in the
-      package. Driver exits are the supervisor's classification protocol:
-      they must go through the NAMED constants in
-      resilience/exitcodes.py, so the exit-code table has exactly one
-      source of truth and a renumbering can never silently fork the
-      supervisor from the drivers. (`sys.exit()` bare and
-      `sys.exit(EXIT_PREEMPTED)` are fine.)
-  R7  (ISSUE 6) gradient collectives — `pmean`/`psum` whose operand names
-      mention gradients — may only appear under `moco_tpu/parallel/`. The
-      step builders (train_step/v3_step) must route gradients through the
-      gradsync API: an inline `lax.pmean(grads, ...)` silently reverts the
-      step to the fused end-of-step reduce, bypassing the configured
-      bucketing/quantization/sparsification AND the comm telemetry that
-      measures it. Collectives on non-gradient values (BN stats, metrics)
-      stay legal anywhere.
-  R6  (ISSUE 5) nothing under `moco_tpu/serve/` may import train,
-      train_step, v3_step, train_state, optimizer modules (optax,
-      ops/schedules) — the serving runtime must stay import-light and
-      train-free: an accidental train dependency drags the optimizer
-      stack (and its compile/memory footprint) into every serving
-      process, and a server that CAN touch training state eventually
-      will. Applies to every import in the file, module-level or lazy.
+Rule summary (full rationale lives on each rule class):
 
-Exit 0 when clean; exit 1 with one `path:line: message` per violation.
-Runs in tier-1 via tests/test_lint_robustness.py (which also holds
-bench.py to R4 even though it lives outside the package tree).
+  R1  no bare `except:`;
+  R2  no pass-only handler over Exception/BaseException;
+  R3  no bare print() outside utils/logging.py, utils/meters.py;
+  R4  Prefetcher/epoch_loader constructions close in a finally
+      (direct `return Prefetcher(...)` is the factory pattern, exempt);
+  R5  no numeric-literal process exits (named exitcodes.py constants);
+  R6  nothing under moco_tpu/serve/ imports the train stack;
+  R7  gradient pmean/psum only under moco_tpu/parallel/.
+
+New work should call the engine directly: `python -m tools.mocolint`.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-BROAD = {"Exception", "BaseException"}
+# The shim is invoked by file path (subprocess tests, importlib loads),
+# so the repo root may not be importable yet.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# the only files allowed to call print(): the structured/sanctioned
-# channels themselves (log_event/info) and the console meters
-PRINT_ALLOWED = ("utils/logging.py", "utils/meters.py")
-
-# R4: constructors whose result owns background staging threads
-LOADER_FACTORIES = {"Prefetcher", "epoch_loader"}
-
-# R6: modules the serving runtime must never import (directly or lazily).
-# Exact module or any submodule; `from moco_tpu import train` counts too.
-R6_FORBIDDEN = (
-    "moco_tpu.train",
-    "moco_tpu.train_step",
-    "moco_tpu.train_state",
-    "moco_tpu.v3_step",
-    "optax",
-    "moco_tpu.ops.schedules",
-)
-R6_FORBIDDEN_TAILS = {m.rsplit(".", 1)[-1] for m in R6_FORBIDDEN}
-
-
-def _r6_module_forbidden(module: str | None) -> bool:
-    if not module:
-        return False
-    return any(module == f or module.startswith(f + ".") for f in R6_FORBIDDEN)
-
-
-def _r6_violations(tree: ast.AST, path: str) -> list[str]:
-    out = []
-
-    def flag(node, module):
-        out.append(
-            f"{path}:{node.lineno}: serve/ imports {module!r} — the serving "
-            "runtime must stay train-free (lint R6): no train, train_step, "
-            "v3_step, train_state, or optimizer modules"
-        )
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                if _r6_module_forbidden(alias.name):
-                    flag(node, alias.name)
-        elif isinstance(node, ast.ImportFrom):
-            if node.level:  # relative import inside serve/: always fine
-                continue
-            if _r6_module_forbidden(node.module):
-                flag(node, node.module)
-            elif node.module in ("moco_tpu", "moco_tpu.ops"):
-                for alias in node.names:
-                    full = f"{node.module}.{alias.name}"
-                    if (alias.name in R6_FORBIDDEN_TAILS
-                            and _r6_module_forbidden(full)):
-                        flag(node, full)
-    return out
-
-def _r7_violation(node: ast.Call) -> bool:
-    """True for `pmean(...)`/`psum(...)` (bare or attribute call, e.g.
-    `lax.pmean`) whose FIRST argument is a name or attribute mentioning
-    gradients (`grads`, `grad_tree`, `g_grads`, ...). Deliberately
-    name-based: the lint guards the obvious regression (pasting the old
-    `_pmean_grads` body back into a step builder), not adversarial
-    renaming."""
-    name = _call_name(node.func)
-    if name not in ("pmean", "psum") or not node.args:
-        return False
-    first = node.args[0]
-    if isinstance(first, ast.Name):
-        return "grad" in first.id.lower()
-    if isinstance(first, ast.Attribute):
-        return "grad" in first.attr.lower()
-    return False
-
-
-def _is_exit_call(func: ast.expr) -> bool:
-    """Exactly the process-exit spellings: `sys.exit`, `os._exit`, the
-    bare builtins `exit`/`SystemExit`. NOT any method that happens to be
-    named exit (`parser.exit(2)` is argparse's API, not the protocol)."""
-    if isinstance(func, ast.Name):
-        return func.id in ("exit", "SystemExit")
-    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
-        return (func.value.id == "sys" and func.attr == "exit") or \
-            (func.value.id == "os" and func.attr == "_exit")
-    return False
-
-
-def _r5_violation(node: ast.Call) -> bool:
-    """True for a process-exit call whose first argument is a bare int
-    literal (bool is an int subclass but `sys.exit(True)` is a different
-    bug — still flagged, deliberately)."""
-    if not _is_exit_call(node.func) or not node.args:
-        return False
-    first = node.args[0]
-    return isinstance(first, ast.Constant) and isinstance(first.value, int)
-
-
-def _call_name(node: ast.expr) -> str | None:
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    return None
-
-
-def _r4_scope_violations(scope: ast.AST, path: str) -> list[str]:
-    """R4 within one function (or module) body, NOT descending into nested
-    function definitions (each is its own scope with its own finallys)."""
-
-    def walk_shallow(node):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda, ast.ClassDef)):
-                continue
-            yield child
-            yield from walk_shallow(child)
-
-    constructions: list[tuple[str | None, int]] = []
-    closed_in_finally: set[str] = set()
-    for node in walk_shallow(scope):
-        if isinstance(node, ast.Call) and _call_name(node.func) in LOADER_FACTORIES:
-            parent = getattr(node, "_r4_parent", None)
-            if isinstance(parent, ast.Return):
-                continue  # factory pattern: the caller owns the close
-            if (isinstance(parent, ast.Assign)
-                    and len(parent.targets) == 1
-                    and isinstance(parent.targets[0], ast.Name)):
-                constructions.append((parent.targets[0].id, node.lineno))
-            else:
-                constructions.append((None, node.lineno))
-        if isinstance(node, ast.Try):
-            for stmt in node.finalbody:
-                for call in ast.walk(stmt):
-                    if (isinstance(call, ast.Call)
-                            and isinstance(call.func, ast.Attribute)
-                            and call.func.attr in ("close", "close_quietly")
-                            and isinstance(call.func.value, ast.Name)):
-                        closed_in_finally.add(call.func.value.id)
-    out = []
-    for var, lineno in constructions:
-        if var is None:
-            out.append(
-                f"{path}:{lineno}: Prefetcher/epoch_loader constructed "
-                "without binding a name — the staging threads can never be "
-                "close()d; bind it and close in a finally"
-            )
-        elif var not in closed_in_finally:
-            out.append(
-                f"{path}:{lineno}: `{var} = ...` builds a Prefetcher but no "
-                f"`finally` in this function calls `{var}.close()`/"
-                f"`{var}.close_quietly()` — an early break leaks the "
-                "staging threads and the staged batches"
-            )
-    return out
-
-
-def _r4_check(tree: ast.AST, path: str) -> list[str]:
-    # annotate each Call with its immediate parent so the Return/Assign
-    # context is known at the Call
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.Call):
-                child._r4_parent = node
-    out = []
-    scopes = [tree] + [
-        n for n in ast.walk(tree)
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-    ]
-    for scope in scopes:
-        out.extend(_r4_scope_violations(scope, path))
-    return out
-
-
-def _names(node: ast.expr | None):
-    """Exception class names a handler catches (dotted tails included)."""
-    if node is None:
-        return []
-    if isinstance(node, ast.Tuple):
-        return [n for elt in node.elts for n in _names(elt)]
-    if isinstance(node, ast.Name):
-        return [node.id]
-    if isinstance(node, ast.Attribute):
-        return [node.attr]
-    return []
-
-
-def _silent(body: list[ast.stmt]) -> bool:
-    return all(
-        isinstance(stmt, ast.Pass)
-        or (isinstance(stmt, ast.Expr)
-            and isinstance(stmt.value, ast.Constant)
-            and stmt.value.value is Ellipsis)
-        for stmt in body
-    )
+from tools.mocolint.config import LEGACY_CONFIG  # noqa: E402
+from tools.mocolint.engine import Engine  # noqa: E402
 
 
 def check_file(path: str) -> list[str]:
-    with open(path, encoding="utf-8") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: unparseable ({e.msg})"]
-    out = []
-    print_allowed = os.path.normpath(path).replace(os.sep, "/").endswith(
-        PRINT_ALLOWED
-    )
-    # R4 everywhere except the defining module itself (its factory returns
-    # and self-methods are the ownership boundary the rule protects)
-    if not os.path.normpath(path).replace(os.sep, "/").endswith(
-        "data/loader.py"
-    ):
-        out.extend(_r4_check(tree, path))
-    if "moco_tpu/serve/" in os.path.normpath(path).replace(os.sep, "/"):
-        out.extend(_r6_violations(tree, path))
-    # R7: gradient collectives live in parallel/ only (the gradsync API)
-    grad_collectives_allowed = (
-        "moco_tpu/parallel/" in os.path.normpath(path).replace(os.sep, "/")
-    )
-    for node in ast.walk(tree):
-        if (not grad_collectives_allowed
-                and isinstance(node, ast.Call) and _r7_violation(node)):
-            out.append(
-                f"{path}:{node.lineno}: gradient collective outside "
-                "moco_tpu/parallel/ — route grads through the gradsync API "
-                "(parallel/gradsync.GradSync); an inline pmean/psum on grads "
-                "bypasses the configured sync mode and its telemetry"
-            )
-            continue
-        if isinstance(node, ast.Call) and _r5_violation(node):
-            out.append(
-                f"{path}:{node.lineno}: numeric-literal process exit — use "
-                "the named constants in resilience/exitcodes.py (the "
-                "supervisor classifies deaths by these codes; a magic "
-                "number here silently forks the protocol)"
-            )
-            continue
-        if (
-            not print_allowed
-            and isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-        ):
-            out.append(
-                f"{path}:{node.lineno}: bare `print(...)` — route through "
-                "utils.logging (log_event for events, info for plain lines) "
-                "so the structured telemetry sinks see it"
-            )
-            continue
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if node.type is None:
-            out.append(
-                f"{path}:{node.lineno}: bare `except:` — name the exception "
-                "types (a bare handler hides SIGINT and the preemption path)"
-            )
-        elif _silent(node.body) and BROAD & set(_names(node.type)):
-            out.append(
-                f"{path}:{node.lineno}: `except "
-                f"{'/'.join(sorted(BROAD & set(_names(node.type))))}` with a "
-                "pass-only body silently swallows every error — narrow the "
-                "type or handle/log it"
-            )
-    return out
+    result = Engine(LEGACY_CONFIG).run([path])
+    return [f.legacy() for f in result.findings]
 
 
 def check_tree(root: str) -> list[str]:
-    out = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
-        for fname in sorted(filenames):
-            if fname.endswith(".py"):
-                out.extend(check_file(os.path.join(dirpath, fname)))
-    return out
+    result = Engine(LEGACY_CONFIG).run([root])
+    return [f.legacy() for f in result.findings]
 
 
 def main(argv: list[str]) -> int:
-    root = argv[1] if len(argv) > 1 else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "moco_tpu"
-    )
+    root = argv[1] if len(argv) > 1 else os.path.join(_REPO, "moco_tpu")
     violations = check_tree(root)
     for v in violations:
         print(v)

@@ -1,0 +1,26 @@
+"""mocolint — the repo's pluggable AST analysis engine (ISSUE 7).
+
+One parse per file feeds every rule through a shared visitor dispatch;
+rules are plugin classes in `tools/mocolint/rules/` registered by id.
+Inline suppression (`# mocolint: disable=R8` — with unused-suppression
+reporting), a committed baseline for grandfathered findings, and `--json`
+machine output ride on top.
+
+Entry points:
+
+    python -m tools.mocolint moco_tpu tools bench.py      # CI gate
+    python -m tools.mocolint --list-rules
+    tools/lint_robustness.py                              # legacy shim
+
+Rule ids: R1–R7 are the migrated robustness rules (behavior pinned by
+tests/test_lint_robustness.py); R8–R11 are the JAX-aware hot-path,
+nondeterminism, thread-safety, and import-boundary rules; PARSE marks
+unparseable files; SUP marks unused suppressions.
+"""
+
+from tools.mocolint.config import DEFAULT_CONFIG, LEGACY_CONFIG  # noqa: F401
+from tools.mocolint.engine import Engine, Result  # noqa: F401
+from tools.mocolint.finding import Finding  # noqa: F401
+from tools.mocolint.registry import all_rules  # noqa: F401
+
+__version__ = "1.0.0"

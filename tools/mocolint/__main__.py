@@ -1,0 +1,5 @@
+import sys
+
+from tools.mocolint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,111 @@
+"""Small AST helpers shared by the rule plugins."""
+
+from __future__ import annotations
+
+import ast
+
+
+def call_name(func: ast.expr) -> str | None:
+    """Tail name of a call target: `pmean`, `lax.pmean` -> "pmean"."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def dotted(expr: ast.expr) -> str | None:
+    """Full dotted spelling of a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return None
+    parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+# Call tails that trace the function passed to them into an XLA program.
+TRACERS = {
+    "jit", "pmap", "shard_map", "vmap", "grad", "value_and_grad",
+    "remat", "checkpoint", "scan", "custom_vjp", "custom_jvp",
+}
+
+
+def traced_functions(tree: ast.AST, parents: dict) -> set[ast.AST]:
+    """Function defs whose bodies run under a jax trace.
+
+    A function is traced when (a) its name is passed to a tracer call
+    (`jax.jit(train_step, ...)`, `shard_map(spmd_region, ...)`,
+    `value_and_grad(loss_fn)`), (b) it is decorated with a tracer
+    (`@jax.jit`, `@functools.partial(jax.jit, ...)`), or (c) it is
+    lexically nested inside a traced function. Name-based on purpose:
+    the lint guards the obvious hazard, not adversarial aliasing.
+    """
+    defs: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+
+    traced: set[ast.AST] = set()
+
+    def mark_by_name(name: str):
+        for fn in defs.get(name, ()):
+            traced.add(fn)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_name(node.func) in TRACERS:
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    mark_by_name(arg.id)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _decorator_traces(deco):
+                    traced.add(node)
+
+    # closure: anything lexically inside a traced function is traced
+    out = set(traced)
+    for fns in defs.values():
+        for fn in fns:
+            cur = parents.get(fn)
+            while cur is not None:
+                if cur in traced:
+                    out.add(fn)
+                    break
+                cur = parents.get(cur)
+    return out
+
+
+def _decorator_traces(deco: ast.expr) -> bool:
+    if call_name(deco) in TRACERS:
+        return True
+    if isinstance(deco, ast.Call):
+        if call_name(deco.func) in TRACERS:
+            return True
+        # functools.partial(jax.jit, donate_argnums=...)
+        if call_name(deco.func) == "partial":
+            for arg in deco.args:
+                if call_name(arg) in TRACERS or (
+                    isinstance(arg, ast.Attribute) and arg.attr in TRACERS
+                ):
+                    return True
+    return False
+
+
+def enclosing_function(node: ast.AST, parents: dict):
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def in_traced_scope(node: ast.AST, parents: dict, traced: set) -> bool:
+    fn = enclosing_function(node, parents)
+    while fn is not None:
+        if fn in traced:
+            return True
+        fn = enclosing_function(fn, parents)
+    return False

@@ -1,0 +1,73 @@
+"""Committed baseline: grandfathered findings that don't fail the build.
+
+A baseline entry is a FINGERPRINT, not a location: `path::rule::hash(message)`
+with an occurrence count. Line numbers churn on every edit, so they are
+deliberately absent — a finding is baselined if its file still contains no
+MORE occurrences of that exact (rule, message) pair than the baseline
+recorded. Fixing one occurrence shrinks the debt silently; introducing a
+new one fails the build even in a file with grandfathered findings.
+
+Format (JSON, stable key order so diffs are reviewable):
+
+    {"version": 1, "findings": {"<fingerprint>": <count>, ...}}
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+
+VERSION = 1
+
+
+def _canon_path(path: str) -> str:
+    """Spelling-independent path key: `moco_tpu/x.py`, `./moco_tpu/x.py`
+    and the absolute form (from the working directory the baseline is
+    used from — the repo root, for the committed one) all fingerprint
+    identically."""
+    return os.path.relpath(path).replace(os.sep, "/")
+
+
+def fingerprint(finding) -> str:
+    digest = hashlib.sha1(finding.message.encode("utf-8")).hexdigest()[:16]
+    return f"{_canon_path(finding.path)}::{finding.rule}::{digest}"
+
+
+def load(path: str) -> dict[str, int]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')!r}, "
+            f"expected {VERSION}"
+        )
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write(path: str, findings) -> int:
+    counts: dict[str, int] = {}
+    for f in findings:
+        key = fingerprint(f)
+        counts[key] = counts.get(key, 0) + 1
+    with open(path, "w", encoding="utf-8") as out:
+        json.dump({"version": VERSION, "findings": dict(sorted(counts.items()))},
+                  out, indent=2, sort_keys=False)
+        out.write("\n")
+    return len(findings)
+
+
+def apply(findings, counts: dict[str, int]):
+    """Split findings into (kept, baselined), consuming baseline budget in
+    finding order."""
+    budget = dict(counts)
+    kept, baselined = [], []
+    for f in findings:
+        key = fingerprint(f)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            baselined.append(f)
+        else:
+            kept.append(f)
+    return kept, baselined

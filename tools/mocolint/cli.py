@@ -1,0 +1,114 @@
+"""mocolint CLI.
+
+    python -m tools.mocolint [paths...]            # default: moco_tpu
+        --json              machine output (schema below)
+        --baseline PATH     subtract grandfathered findings
+        --write-baseline PATH   snapshot current findings and exit 0
+        --select R8,R10     run only these rules
+        --list-rules        print the rule table and exit
+
+Exit codes: 0 clean, 1 findings, 2 usage/config error.
+
+JSON schema (version 1):
+    {"version": 1, "tool": "mocolint", "files_scanned": N,
+     "findings": [{"path","line","col","rule","severity","message"}...],
+     "suppressed": N, "baselined": N}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _bootstrap_path() -> None:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+
+def main(argv: list[str] | None = None) -> int:
+    _bootstrap_path()
+    from tools.mocolint.config import DEFAULT_CONFIG
+    from tools.mocolint.engine import Engine
+    from tools.mocolint.registry import all_rules
+
+    parser = argparse.ArgumentParser(
+        prog="mocolint", description="moco_tpu static analysis")
+    parser.add_argument("paths", nargs="*", default=None)
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument("--baseline", default=None)
+    parser.add_argument("--write-baseline", default=None)
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid, cls in sorted(all_rules().items(),
+                               key=lambda kv: (len(kv[0]), kv[0])):
+            print(f"{rid:<4} [{cls.severity}] {cls.title}")
+            print(f"     why: {cls.rationale}")
+        return 0
+
+    paths = args.paths or ["moco_tpu"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"mocolint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    select = None
+    if args.select:
+        select = tuple(s.strip() for s in args.select.split(",") if s.strip())
+        unknown = [s for s in select if s not in all_rules()]
+        if unknown:
+            print(f"mocolint: unknown rule id(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    engine = Engine(DEFAULT_CONFIG, select=select)
+    if args.write_baseline:
+        result = engine.run(paths, baseline_path=None)
+        from tools.mocolint import baseline as baseline_mod
+        n = baseline_mod.write(args.write_baseline, result.findings)
+        print(f"wrote baseline of {n} finding(s) to {args.write_baseline}")
+        return 0
+
+    try:
+        result = engine.run(paths, baseline_path=args.baseline)
+    except (OSError, ValueError) as e:
+        print(f"mocolint: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "tool": "mocolint",
+            "files_scanned": result.files_scanned,
+            "findings": [f.json_obj() for f in result.findings],
+            "suppressed": len(result.suppressed),
+            "baselined": len(result.baselined),
+        }, indent=2))
+        return 1 if result.findings else 0
+
+    for f in result.findings:
+        print(f.human())
+    tail = []
+    if result.suppressed:
+        tail.append(f"{len(result.suppressed)} suppressed")
+    if result.baselined:
+        tail.append(f"{len(result.baselined)} baselined")
+    suffix = f" ({', '.join(tail)})" if tail else ""
+    if result.findings:
+        print(f"{len(result.findings)} finding(s) in "
+              f"{result.files_scanned} file(s){suffix}")
+        return 1
+    print(f"mocolint clean: {result.files_scanned} file(s){suffix}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,213 @@
+"""Lint configuration: which rules run where, and the boundary graph.
+
+Scoping is PATH-BASED with two pattern shapes, matching the conventions
+the monolithic linter already used (so the shim is bit-compatible):
+
+  - a pattern ending in "/" is a directory: it matches any file whose
+    normalized path contains that directory segment
+    ("moco_tpu/serve/" matches "/tmp/x/moco_tpu/serve/mod.py");
+  - any other pattern is a file suffix: it matches a path that equals it
+    or ends with "/" + it ("utils/logging.py").
+
+Two stock configs ship:
+
+  DEFAULT_CONFIG — what `python -m tools.mocolint` runs: all rules, with
+    package-only scoping for the rules that guard package conventions
+    (R3 print-discipline and R5 exit-codes are moco_tpu/ contracts; the
+    CLI scripts under tools/ print and exit by design).
+  LEGACY_CONFIG — exactly the monolithic tools/lint_robustness.py
+    behavior: rules R1–R7 with their historical scoping, everywhere the
+    caller points it. The shim and its pinned tests run this.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+def norm(path: str) -> str:
+    return os.path.normpath(path).replace(os.sep, "/")
+
+
+def path_matches(path: str, pattern: str) -> bool:
+    p = norm(path)
+    if pattern.endswith("/"):
+        return ("/" + pattern) in ("/" + p)
+    return p == pattern or p.endswith("/" + pattern)
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleScope:
+    """Empty include = everywhere; exclude always wins."""
+
+    include: tuple[str, ...] = ()
+    exclude: tuple[str, ...] = ()
+
+    def contains(self, path: str) -> bool:
+        if any(path_matches(path, pat) for pat in self.exclude):
+            return False
+        if not self.include:
+            return True
+        return any(path_matches(path, pat) for pat in self.include)
+
+
+@dataclasses.dataclass(frozen=True)
+class Boundary:
+    """One entry of the import-boundary graph (rules R6/R11).
+
+    `forbid` bans direct imports (module-level AND lazy) of the listed
+    module prefixes from files in `scope`. With `transitive=True` the ban
+    extends through module-level imports of in-repo modules: importing A
+    which imports B which imports a forbidden module is a violation AT
+    the original import site, reported with the chain.
+
+    `stdlib_only=True` instead requires every direct import to be stdlib
+    or begin with an `allow_prefixes` entry — and, transitively, every
+    module-level import reachable through allowed in-repo modules too.
+
+    `lazy_only` lists modules that must never be imported at module
+    level in `scope` (function-local imports stay legal) — the orbax
+    contract: the import cost/dependency is paid only on the code path
+    that needs it.
+    """
+
+    name: str
+    rule_id: str
+    scope: tuple[str, ...]
+    why: str
+    forbid: tuple[str, ...] = ()
+    transitive: bool = False
+    stdlib_only: bool = False
+    allow_prefixes: tuple[str, ...] = ()
+    lazy_only: tuple[str, ...] = ()
+
+    def in_scope(self, path: str) -> bool:
+        return any(path_matches(path, pat) for pat in self.scope)
+
+
+@dataclasses.dataclass(frozen=True)
+class LintConfig:
+    enabled: tuple[str, ...]
+    scopes: dict          # rule id -> RuleScope (missing = everywhere)
+    boundaries: tuple[Boundary, ...] = ()
+    report_unused_suppressions: bool = True
+
+    def rule_enabled(self, rule_id: str) -> bool:
+        return rule_id in self.enabled
+
+    def scope_for(self, rule_id: str) -> RuleScope:
+        return self.scopes.get(rule_id, _EVERYWHERE)
+
+
+_EVERYWHERE = RuleScope()
+
+# R6's forbidden-module list: the serving runtime must stay train-free.
+SERVE_FORBIDDEN = (
+    "moco_tpu.train",
+    "moco_tpu.train_step",
+    "moco_tpu.train_state",
+    "moco_tpu.v3_step",
+    "optax",
+    "moco_tpu.ops.schedules",
+)
+
+# Historical scoping of the monolithic linter, shared by both configs.
+_R1_R7_SCOPES = {
+    "R3": RuleScope(exclude=("utils/logging.py", "utils/meters.py")),
+    "R4": RuleScope(exclude=("data/loader.py",)),
+    "R6": RuleScope(include=("moco_tpu/serve/",)),
+    "R7": RuleScope(exclude=("moco_tpu/parallel/",)),
+}
+
+_SERVE_BOUNDARY = Boundary(
+    name="serve-train-free",
+    rule_id="R6",
+    scope=("moco_tpu/serve/",),
+    forbid=SERVE_FORBIDDEN,
+    why=("the serving runtime must stay import-light and train-free: a "
+         "train dependency drags the optimizer stack into every serving "
+         "process"),
+)
+
+LEGACY_CONFIG = LintConfig(
+    enabled=("R1", "R2", "R3", "R4", "R5", "R6", "R7"),
+    scopes=dict(_R1_R7_SCOPES),
+    boundaries=(_SERVE_BOUNDARY,),
+    report_unused_suppressions=False,
+)
+
+# Modules whose values are covered by a bit-identity contract (resume /
+# staging / serve parity) — R9's scope. Python-side nondeterminism here
+# breaks guarantees tests elsewhere pin.
+BIT_IDENTITY_MODULES = (
+    "moco_tpu/train_step.py",
+    "moco_tpu/v3_step.py",
+    "moco_tpu/data/augment.py",
+    "moco_tpu/data/loader.py",
+    "moco_tpu/data/canvas_cache.py",
+    "moco_tpu/data/datasets.py",
+    "moco_tpu/serve/engine.py",
+    "moco_tpu/ops/",
+    "moco_tpu/parallel/",
+)
+
+# Modules that build jitted step programs — R8's scope (within which only
+# traced-function bodies are checked).
+STEP_BUILDER_MODULES = (
+    "moco_tpu/train_step.py",
+    "moco_tpu/v3_step.py",
+    "moco_tpu/serve/engine.py",
+    "moco_tpu/ops/",
+    "moco_tpu/data/augment.py",
+)
+
+DEFAULT_CONFIG = LintConfig(
+    enabled=("R1", "R2", "R3", "R4", "R5", "R6", "R7",
+             "R8", "R9", "R10", "R11"),
+    scopes={
+        **_R1_R7_SCOPES,
+        # package contracts: the CLI scripts in tools/ print and exit(N)
+        # by design, so the package-convention rules scope to moco_tpu/
+        "R3": RuleScope(include=("moco_tpu/",),
+                        exclude=("utils/logging.py", "utils/meters.py")),
+        "R5": RuleScope(include=("moco_tpu/", "tools/supervise.py",
+                                 "tools/serve.py")),
+        "R8": RuleScope(include=STEP_BUILDER_MODULES),
+        "R9": RuleScope(include=BIT_IDENTITY_MODULES),
+    },
+    boundaries=(
+        _SERVE_BOUNDARY,
+        Boundary(
+            name="serve-train-free-transitive",
+            rule_id="R11",
+            scope=("moco_tpu/serve/",),
+            forbid=SERVE_FORBIDDEN,
+            transitive=True,
+            why=("an import CHAIN from serve/ to the train stack defeats "
+                 "R6 exactly as a direct import would — the optimizer "
+                 "lands in the serving process either way"),
+        ),
+        Boundary(
+            name="supervisor-stdlib-only",
+            rule_id="R11",
+            scope=("moco_tpu/resilience/supervisor.py", "tools/supervise.py"),
+            stdlib_only=True,
+            allow_prefixes=("moco_tpu",),
+            transitive=True,
+            why=("the out-of-process supervisor must survive exactly the "
+                 "failures that kill jax (poisoned compile cache, OOM'd "
+                 "runtime) — importing the stack it supervises couples "
+                 "their fates"),
+        ),
+        Boundary(
+            name="checkpoint-orbax-lazy",
+            rule_id="R11",
+            scope=("moco_tpu/checkpoint.py",),
+            lazy_only=("orbax", "optax", "moco_tpu.train_state"),
+            why=("checkpoint.py is also the inference-side loader (the "
+                 "serve/ path): a module-level orbax/optax import drags "
+                 "the training stack into every serving process"),
+        ),
+    ),
+)

@@ -1,0 +1,274 @@
+"""The analysis engine: one parse per file, one walk, all rules.
+
+The monolithic linter re-walked the AST once per rule family; this engine
+parses each file exactly once, annotates parents, and dispatches every
+node to the rules that registered for its type during a single shared
+walk. File-level and cross-file hooks run after. On this repo (~110
+files) a full run is well under a second — the tier-1 budget is 5 s.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+from tools.mocolint import baseline as baseline_mod
+from tools.mocolint import suppress
+from tools.mocolint.config import DEFAULT_CONFIG, LintConfig, norm
+from tools.mocolint.finding import Finding, sort_findings
+from tools.mocolint.registry import all_rules
+
+
+@dataclasses.dataclass
+class ImportEdge:
+    """One import statement: the dotted module it names, where, and
+    whether it executes at module import time (lazy = inside a function)."""
+
+    module: str
+    line: int
+    lazy: bool
+    type_checking: bool  # inside `if TYPE_CHECKING:` — never executes
+
+
+class FileContext:
+    """Everything the rules may want about one parsed file."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST):
+        self.path = path              # as the caller spelled it
+        self.norm = norm(path)
+        self.source = source
+        self.tree = tree
+        self.parents: dict = {}
+        self.suppressions = suppress.scan(source)
+        self.module = module_name_for(self.norm)
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.imports = _collect_imports(tree, self.parents)
+
+    def parent(self, node):
+        return self.parents.get(node)
+
+    def ancestors(self, node):
+        node = self.parents.get(node)
+        while node is not None:
+            yield node
+            node = self.parents.get(node)
+
+
+def module_name_for(norm_path: str) -> str | None:
+    """Dotted module name for in-package files, from the LAST `moco_tpu`
+    path segment ("/tmp/x/moco_tpu/serve/http.py" -> "moco_tpu.serve.http").
+    Files outside a moco_tpu tree get None: they are not import targets
+    of the package graph."""
+    parts = norm_path.split("/")
+    if "moco_tpu" not in parts:
+        return None
+    i = len(parts) - 1 - parts[::-1].index("moco_tpu")
+    rel = parts[i:]
+    if not rel[-1].endswith(".py"):
+        return None
+    rel[-1] = rel[-1][:-3]
+    if rel[-1] == "__init__":
+        rel = rel[:-1]
+    return ".".join(rel)
+
+
+def _in_type_checking(node, parents) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.If):
+            t = cur.test
+            if (isinstance(t, ast.Name) and t.id == "TYPE_CHECKING") or (
+                isinstance(t, ast.Attribute) and t.attr == "TYPE_CHECKING"
+            ):
+                return True
+        cur = parents.get(cur)
+    return False
+
+
+def _collect_imports(tree, parents) -> list[ImportEdge]:
+    out: list[ImportEdge] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        lazy = any(
+            isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+            for a in _ancestors(node, parents)
+        )
+        tc = _in_type_checking(node, parents)
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out.append(ImportEdge(alias.name, node.lineno, lazy, tc))
+        else:
+            if node.level:  # relative: resolved by the boundary rule if
+                continue    # needed; every current contract is absolute
+            base = node.module or ""
+            out.append(ImportEdge(base, node.lineno, lazy, tc))
+            # `from pkg import sub` may name a submodule: record both
+            for alias in node.names:
+                if base:
+                    out.append(
+                        ImportEdge(f"{base}.{alias.name}", node.lineno,
+                                   lazy, tc)
+                    )
+    return out
+
+
+def _ancestors(node, parents):
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)
+
+
+class Project:
+    """Cross-file view handed to finalize(): all parsed contexts plus the
+    module-level import graph keyed by dotted module name."""
+
+    def __init__(self, contexts: list[FileContext]):
+        self.contexts = contexts
+        self.by_module: dict[str, FileContext] = {}
+        for ctx in contexts:
+            if ctx.module:
+                self.by_module[ctx.module] = ctx
+
+    def resolve(self, module: str) -> FileContext | None:
+        """Context for `module`, tolerating package-vs-module spelling."""
+        if module in self.by_module:
+            return self.by_module[module]
+        return None
+
+
+@dataclasses.dataclass
+class Result:
+    findings: list          # what the caller should fail on
+    suppressed: list        # dropped by inline suppressions
+    baselined: list         # dropped by the baseline file
+    files_scanned: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def collect_files(paths) -> list[str]:
+    """Expand dirs to sorted .py files, deduplicating overlapping inputs
+    (a dir plus a file inside it must not scan the file twice: doubled
+    findings would blow past their baseline budget)."""
+    out, seen = [], set()
+
+    def add(p):
+        key = os.path.abspath(p)
+        if key not in seen:
+            seen.add(key)
+            out.append(p)
+
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__"
+                )
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        add(os.path.join(dirpath, fname))
+        else:
+            add(path)
+    return out
+
+
+class Engine:
+    def __init__(self, config: LintConfig = DEFAULT_CONFIG,
+                 select: tuple[str, ...] | None = None):
+        self.config = config
+        classes = all_rules()
+        ids = [
+            rid for rid in classes
+            if config.rule_enabled(rid) and (select is None or rid in select)
+        ]
+        self.rules = [classes[rid]() for rid in ids]
+        for rule in self.rules:
+            rule.config = config
+        # whether a --select subset is running: unused-suppression
+        # reporting must not flag suppressions of rules that never ran
+        self._subset = select is not None
+
+    def run(self, paths, baseline_path: str | None = None) -> Result:
+        contexts: list[FileContext] = []
+        findings: list[Finding] = []
+        for path in collect_files(paths):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError as e:
+                findings.append(Finding(path, 0, "PARSE",
+                                        f"unreadable ({e})"))
+                continue
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError as e:
+                findings.append(Finding(path, e.lineno or 0, "PARSE",
+                                        f"unparseable ({e.msg})"))
+                continue
+            ctx = FileContext(path, source, tree)
+            contexts.append(ctx)
+            findings.extend(self._check_file(ctx))
+        project = Project(contexts)
+        for rule in self.rules:
+            findings.extend(rule.finalize(project))
+        # suppressions are per-file; group findings back to their context
+        supp_by_path = {c.path: c.suppressions for c in contexts}
+        kept, suppressed = [], []
+        for path, sups in supp_by_path.items():
+            mine = [f for f in findings if f.path == path]
+            k, s = suppress.apply(mine, sups)
+            kept.extend(k)
+            suppressed.extend(s)
+        kept.extend(f for f in findings if f.path not in supp_by_path)
+        if self.config.report_unused_suppressions:
+            active = {r.id for r in self.rules}
+            for ctx in contexts:
+                for s in ctx.suppressions:
+                    if s.used:
+                        continue
+                    # under --select, a suppression of an unselected rule
+                    # (or of "all") cannot prove itself used — skip it; a
+                    # full run still reports every unused one, typos
+                    # included
+                    if self._subset and not (s.rules & active):
+                        continue
+                    kept.append(Finding(
+                        ctx.path, s.line, "SUP",
+                        "unused suppression "
+                        f"({', '.join(sorted(s.rules))}) — nothing it "
+                        "covers fires any more; delete it so a "
+                        "regression cannot hide behind it",
+                    ))
+        baselined: list[Finding] = []
+        if baseline_path:
+            counts = baseline_mod.load(baseline_path)
+            kept, baselined = baseline_mod.apply(sort_findings(kept), counts)
+        return Result(
+            findings=sort_findings(kept),
+            suppressed=suppressed,
+            baselined=baselined,
+            files_scanned=len(contexts),
+        )
+
+    def _check_file(self, ctx: FileContext):
+        scoped = [r for r in self.rules
+                  if self.config.scope_for(r.id).contains(ctx.path)]
+        if not scoped:
+            return
+        by_type = {}
+        for rule in scoped:
+            for node_type in rule.node_types:
+                by_type.setdefault(node_type, []).append(rule)
+        if by_type:
+            for node in ast.walk(ctx.tree):
+                for rule in by_type.get(type(node), ()):
+                    yield from rule.visit(node, ctx)
+        for rule in scoped:
+            yield from rule.check_file(ctx)

@@ -1,0 +1,52 @@
+"""The one record every rule produces and every consumer reads."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation at one source location.
+
+    `path` is the path exactly as the caller spelled it (tests and
+    editors match on it verbatim); scoping normalizes it on the
+    FileContext, and baselines fingerprint a spelling-independent form
+    (baseline._canon_path). `message` must NOT embed the location or the
+    rule id: formatting is the consumer's choice.
+    """
+
+    path: str
+    line: int
+    rule: str
+    message: str
+    col: int = 0
+    severity: str = "error"
+
+    def legacy(self) -> str:
+        """The pre-mocolint `path:line: message` string (lint_robustness
+        shim contract — no rule id in the text)."""
+        return f"{self.path}:{self.line}: {self.message}"
+
+    def human(self) -> str:
+        """`path:line: RULE message` — the mocolint CLI format."""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def json_obj(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+        }
+
+
+def sort_findings(findings: list[Finding]) -> list[Finding]:
+    """Stable order: by file path, then line/col, then rule id. (The
+    monolith emitted R4 findings before the node-walk rules and grouped
+    files in os.walk order; every per-file count and line the pinned
+    tests assert survives the resort, but raw output order on a dirty
+    tree can differ.)"""
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))

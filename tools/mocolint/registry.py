@@ -1,0 +1,65 @@
+"""Rule registry: every rule is a plugin class registered by id.
+
+A rule declares WHAT it checks (metadata: id, title, severity, rationale)
+and implements up to three hooks, all generators of `Finding`:
+
+  visit(node, ctx)      — called once per AST node whose type appears in
+                          `node_types`, during the engine's single shared
+                          walk of the file. The cheap common case.
+  check_file(ctx)       — called once per in-scope file, after the walk.
+                          For rules that need whole-file structure
+                          (scopes, class shapes, traced-function closure).
+  finalize(project)     — called once per RUN, after every file was
+                          parsed. For cross-file rules (import graphs).
+
+Rules are instantiated fresh per Engine run, so a rule may accumulate
+state across visit()/check_file() calls and flush it in finalize().
+"""
+
+from __future__ import annotations
+
+from tools.mocolint.finding import Finding
+
+
+class Rule:
+    """Base class; subclasses override the metadata and any hooks."""
+
+    id: str = ""
+    title: str = ""
+    severity: str = "error"
+    rationale: str = ""
+    node_types: tuple = ()
+
+    def visit(self, node, ctx):
+        return ()
+
+    def check_file(self, ctx):
+        return ()
+
+    def finalize(self, project):
+        return ()
+
+    # helper so rule bodies stay terse
+    def finding(self, ctx, line: int, message: str, col: int = 0) -> Finding:
+        return Finding(path=ctx.path, line=line, rule=self.id,
+                       message=message, col=col, severity=self.severity)
+
+
+_RULES: dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator: adds the rule to the global registry."""
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in _RULES:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _RULES[cls.id] = cls
+    return cls
+
+
+def all_rules() -> dict[str, type]:
+    """id -> class, after ensuring the built-in rule modules loaded."""
+    import tools.mocolint.rules  # noqa: F401  (registration side effect)
+
+    return dict(_RULES)

@@ -1,0 +1,13 @@
+"""Built-in rule plugins. Importing this package registers every rule."""
+
+from tools.mocolint.rules import (  # noqa: F401
+    boundaries,
+    collectives,
+    determinism,
+    exceptions,
+    exits,
+    hostsync,
+    loaders,
+    printing,
+    threadsafety,
+)

@@ -1,0 +1,266 @@
+"""R6/R11: the config-driven import-boundary graph.
+
+R6 is the original serve-is-train-free check, now one `Boundary` entry
+instead of a hand-rolled walker (behavior and message pinned by
+tests/test_lint_robustness.py). R11 generalizes it three ways:
+
+  - transitive forbids: an import CHAIN that reaches a forbidden module
+    through module-level imports of in-repo modules is flagged at the
+    originating import, with the chain in the message;
+  - stdlib-only scopes: the supervisor processes must import nothing
+    outside the standard library except moco_tpu modules that are
+    themselves (transitively, at module level) stdlib-only;
+  - lazy-only modules: heavy optional deps (orbax) may be imported only
+    inside functions, never at module level.
+
+Lazy (function-body) imports count for DIRECT forbids — a lazy train
+import still drags the stack in when the function runs — but transitive
+walks follow only module-level edges: a lazy import inside a reached
+module is a deliberately deferred dependency (the exact pattern
+checkpoint.py uses to keep orbax off the serve path).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+
+from tools.mocolint.registry import Rule, register
+
+_STDLIB = frozenset(getattr(sys, "stdlib_module_names", ())) | {"__future__"}
+
+
+def _root(module: str) -> str:
+    return module.split(".", 1)[0]
+
+
+def _is_stdlib(module: str) -> bool:
+    return _root(module) in _STDLIB
+
+
+def _forbidden_by(module: str, forbid) -> str | None:
+    for f in forbid:
+        if module == f or module.startswith(f + "."):
+            return f
+    return None
+
+
+def _resolve(project, module: str):
+    """FileContext for `module`, falling back one level (an edge like
+    `pkg.mod.symbol` from `from pkg.mod import symbol` resolves to
+    `pkg.mod`)."""
+    ctx = project.resolve(module)
+    if ctx is None and "." in module:
+        ctx = project.resolve(module.rsplit(".", 1)[0])
+    return ctx
+
+
+def _with_ancestors(module: str):
+    """`a.b.c` -> [a, a.b, a.b.c]: importing a submodule executes every
+    ancestor package's __init__, so the walk must include them."""
+    parts = module.split(".")
+    return [".".join(parts[: i + 1]) for i in range(len(parts))]
+
+
+def _seed(project, module: str):
+    """Initial BFS frontier for `module`: itself plus every resolvable
+    ancestor package (their __init__ bodies execute on import too)."""
+    frontier, visited = [], set()
+    for anc in _with_ancestors(module):
+        if anc not in visited and project.resolve(anc):
+            visited.add(anc)
+            frontier.append((anc, [anc]))
+    return frontier, visited
+
+
+@register
+class ServeTrainFree(Rule):
+    """R6 — direct forbidden imports inside a boundary scope."""
+
+    id = "R6"
+    title = "serve/ never imports the train stack"
+    rationale = ("a server that CAN touch training state eventually will; "
+                 "the optimizer stack also bloats every serving process")
+    node_types = (ast.Import, ast.ImportFrom)
+
+    def _boundaries(self, ctx):
+        return [b for b in self.config.boundaries
+                if b.rule_id == self.id and b.forbid and not b.transitive
+                and b.in_scope(ctx.path)]
+
+    def visit(self, node, ctx):
+        for b in self._boundaries(ctx):
+            parents = {f.rsplit(".", 1)[0] for f in b.forbid if "." in f}
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if _forbidden_by(alias.name, b.forbid):
+                        yield self._flag(ctx, node, alias.name)
+            else:
+                if node.level:  # relative import inside the scope: fine
+                    continue
+                if _forbidden_by(node.module, b.forbid):
+                    yield self._flag(ctx, node, node.module)
+                elif node.module in parents:
+                    for alias in node.names:
+                        full = f"{node.module}.{alias.name}"
+                        if _forbidden_by(full, b.forbid):
+                            yield self._flag(ctx, node, full)
+
+    def _flag(self, ctx, node, module):
+        return self.finding(
+            ctx, node.lineno,
+            f"serve/ imports {module!r} — the serving runtime must stay "
+            "train-free (lint R6): no train, train_step, v3_step, "
+            "train_state, or optimizer modules",
+        )
+
+
+@register
+class ImportBoundary(Rule):
+    """R11 — transitive forbids, stdlib-only scopes, lazy-only modules."""
+
+    id = "R11"
+    title = "config-driven cross-file import boundaries"
+    rationale = ("single-purpose import checks don't scale; every boundary "
+                 "is one config entry against the same graph walker")
+
+    def check_file(self, ctx):
+        for b in self.config.boundaries:
+            if b.rule_id != self.id or not b.in_scope(ctx.path):
+                continue
+            if b.lazy_only:
+                yield from self._check_lazy_only(ctx, b)
+            if b.stdlib_only:
+                yield from self._check_stdlib_direct(ctx, b)
+
+    def _check_lazy_only(self, ctx, b):
+        seen = set()
+        for edge in ctx.imports:
+            if edge.lazy or edge.type_checking:
+                continue
+            hit = _forbidden_by(edge.module, b.lazy_only)
+            if hit and (edge.line, hit) not in seen:
+                seen.add((edge.line, hit))
+                yield self.finding(
+                    ctx, edge.line,
+                    f"module-level import of {edge.module!r} — "
+                    f"[{b.name}] {hit} must be imported lazily (inside the "
+                    f"function that needs it): {b.why}",
+                )
+
+    def _check_stdlib_direct(self, ctx, b):
+        seen = set()
+        for edge in ctx.imports:
+            if edge.type_checking:
+                continue
+            if _is_stdlib(edge.module):
+                continue
+            if any(_root(edge.module) == p or edge.module.startswith(p + ".")
+                   or edge.module == p for p in b.allow_prefixes):
+                continue
+            if (edge.line, _root(edge.module)) in seen:
+                continue
+            seen.add((edge.line, _root(edge.module)))
+            yield self.finding(
+                ctx, edge.line,
+                f"imports {edge.module!r} — [{b.name}] this file is "
+                f"stdlib-only: {b.why}",
+            )
+
+    def finalize(self, project):
+        for b in self.config.boundaries:
+            if b.rule_id != self.id or not b.transitive:
+                continue
+            for ctx in project.contexts:
+                if not b.in_scope(ctx.path):
+                    continue
+                if b.forbid:
+                    yield from self._walk_forbid(project, ctx, b)
+                if b.stdlib_only:
+                    yield from self._walk_stdlib(project, ctx, b)
+
+    def _module_edges(self, ctx):
+        """Module-level (non-lazy, non-TYPE_CHECKING) imports of a file."""
+        return [e for e in ctx.imports if not e.lazy and not e.type_checking]
+
+    def _walk_forbid(self, project, ctx, b):
+        reported = set()
+        for edge in ctx.imports:
+            if edge.type_checking:
+                continue
+            if _forbidden_by(edge.module, b.forbid):
+                continue  # direct violation: R6's finding, not a chain
+            chain = self._find_chain(project, edge.module, b.forbid)
+            if chain and (edge.line, chain[-1]) not in reported:
+                reported.add((edge.line, chain[-1]))
+                yield self.finding(
+                    ctx, edge.line,
+                    f"import chain reaches {chain[-1]!r}: "
+                    f"{' -> '.join([edge.module] + chain[1:])} — "
+                    f"[{b.name}] {b.why}",
+                )
+
+    def _find_chain(self, project, module, forbid):
+        """BFS over module-level edges from `module`; returns the module
+        chain ending at a forbidden import, or None. Terminates without a
+        budget: the visited set admits each project module once."""
+        start = _resolve(project, module)
+        if start is None or start.module is None:
+            return None
+        frontier, visited = _seed(project, start.module)
+        while frontier:
+            name, chain = frontier.pop(0)
+            ctx = project.resolve(name)
+            if ctx is None:
+                continue
+            for edge in self._module_edges(ctx):
+                if _forbidden_by(edge.module, forbid):
+                    return chain + [edge.module]
+                for anc in _with_ancestors(edge.module):
+                    if anc not in visited and project.resolve(anc):
+                        visited.add(anc)
+                        frontier.append((anc, chain + [anc]))
+        return None
+
+    def _walk_stdlib(self, project, ctx, b):
+        reported = set()
+        for edge in ctx.imports:
+            if edge.type_checking or _is_stdlib(edge.module):
+                continue
+            if not any(edge.module == p or edge.module.startswith(p + ".")
+                       for p in b.allow_prefixes):
+                continue  # direct non-allowed imports: _check_stdlib_direct
+            bad = self._stdlib_chain(project, edge.module, b)
+            if bad and (edge.line, bad[-1]) not in reported:
+                reported.add((edge.line, bad[-1]))
+                yield self.finding(
+                    ctx, edge.line,
+                    f"import chain reaches non-stdlib {bad[-1]!r}: "
+                    f"{' -> '.join([edge.module] + bad[1:])} — "
+                    f"[{b.name}] {b.why}",
+                )
+
+    def _stdlib_chain(self, project, module, b):
+        start = _resolve(project, module)
+        if start is None or start.module is None:
+            return None
+        frontier, visited = _seed(project, start.module)
+        while frontier:
+            name, chain = frontier.pop(0)
+            ctx = project.resolve(name)
+            if ctx is None:
+                continue
+            for edge in self._module_edges(ctx):
+                if _is_stdlib(edge.module):
+                    continue
+                allowed = any(
+                    edge.module == p or edge.module.startswith(p + ".")
+                    for p in b.allow_prefixes
+                )
+                if not allowed:
+                    return chain + [edge.module]
+                for anc in _with_ancestors(edge.module):
+                    if anc not in visited and project.resolve(anc):
+                        visited.add(anc)
+                        frontier.append((anc, chain + [anc]))
+        return None

@@ -1,0 +1,43 @@
+"""R7: gradient collectives live in moco_tpu/parallel/ only.
+
+An inline `lax.pmean(grads, ...)` in a step builder silently reverts the
+step to the fused end-of-step reduce, bypassing the configured
+bucketing/quantization/sparsification AND the comm telemetry measuring
+it. Name-based on purpose: the lint guards the obvious regression, not
+adversarial renaming.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.mocolint.astutil import call_name
+from tools.mocolint.registry import Rule, register
+
+
+@register
+class GradCollective(Rule):
+    id = "R7"
+    title = "gradient pmean/psum only under moco_tpu/parallel/"
+    rationale = ("grads must route through the gradsync API so the "
+                 "configured sync mode and its telemetry stay in effect")
+    node_types = (ast.Call,)
+
+    def visit(self, node, ctx):
+        if call_name(node.func) not in ("pmean", "psum") or not node.args:
+            return
+        first = node.args[0]
+        if isinstance(first, ast.Name):
+            graddy = "grad" in first.id.lower()
+        elif isinstance(first, ast.Attribute):
+            graddy = "grad" in first.attr.lower()
+        else:
+            graddy = False
+        if graddy:
+            yield self.finding(
+                ctx, node.lineno,
+                "gradient collective outside moco_tpu/parallel/ — route "
+                "grads through the gradsync API (parallel/gradsync.GradSync)"
+                "; an inline pmean/psum on grads bypasses the configured "
+                "sync mode and its telemetry",
+            )

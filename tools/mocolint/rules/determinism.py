@@ -1,0 +1,101 @@
+"""R9: Python-side nondeterminism in bit-identity-contracted code.
+
+The resume and serve contracts promise BIT-IDENTICAL replays: the same
+seed and step index must reproduce the same batch, augmentation, and
+embedding. Python's global RNGs (`random.*`, `np.random.<fn>` on the
+global state), wall-clock values flowing into computation, and
+hash-order iteration silently break that — the run still "works", it
+just can never be replayed, and pod replicas quietly diverge.
+
+Allowed by design: explicitly seeded generator CONSTRUCTION
+(`np.random.RandomState(seed)`, `np.random.default_rng(seed)`) — that is
+the sanctioned deterministic pattern the loaders use; `time.perf_counter`
+for telemetry (it measures, it never feeds values).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.mocolint.registry import Rule, register
+
+_SEEDED_CTORS = {"RandomState", "default_rng", "Generator", "PCG64",
+                 "SeedSequence"}
+_TIME_VALUES = {"time", "time_ns"}
+
+
+@register
+class PythonNondeterminism(Rule):
+    id = "R9"
+    title = "no Python-side nondeterminism in bit-identity code"
+    rationale = ("global-RNG draws, wall-clock values, and hash-order "
+                 "iteration silently break the bit-identical resume/serve "
+                 "replay guarantees")
+    node_types = (ast.Call, ast.For, ast.comprehension)
+
+    def visit(self, node, ctx):
+        if isinstance(node, ast.Call):
+            yield from self._check_call(node, ctx)
+        else:
+            iter_expr = node.iter
+            yield from self._check_iteration(iter_expr, node, ctx)
+
+    def _check_call(self, node, ctx):
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return
+        base = func.value
+        # random.<fn> on the module's hidden global state
+        seeded = bool(node.args or node.keywords)  # seed=... counts too
+        if isinstance(base, ast.Name) and base.id == "random":
+            if func.attr == "Random" and seeded:
+                return  # random.Random(seed): explicit, deterministic
+            yield self.finding(
+                ctx, node.lineno,
+                f"`random.{func.attr}(...)` — the process-global RNG is "
+                "unseeded, unshared across hosts, and consumed in "
+                "whatever order threads race to it; derive values from "
+                "the run seed (np.random.RandomState(seed) / "
+                "jax.random.fold_in)",
+            )
+            return
+        # np.random.<fn> on numpy's global state
+        if (isinstance(base, ast.Attribute) and base.attr == "random"
+                and isinstance(base.value, ast.Name)
+                and base.value.id in ("np", "numpy")):
+            if func.attr in _SEEDED_CTORS and seeded:
+                return  # explicitly seeded constructor: the sanctioned path
+            yield self.finding(
+                ctx, node.lineno,
+                f"`np.random.{func.attr}(...)` — numpy's GLOBAL rng state; "
+                "replays and pod replicas diverge. Construct "
+                "np.random.RandomState(seed)/default_rng(seed) from the "
+                "run seed instead",
+            )
+            return
+        # time.time()/time_ns() producing a VALUE in contracted code
+        if (isinstance(base, ast.Name) and base.id == "time"
+                and func.attr in _TIME_VALUES):
+            yield self.finding(
+                ctx, node.lineno,
+                f"`time.{func.attr}()` in bit-identity-contracted code — "
+                "a wall-clock value can never replay; use the step index "
+                "or the run seed for values (time.perf_counter is fine "
+                "for telemetry durations)",
+            )
+
+    def _check_iteration(self, iter_expr, node, ctx):
+        hazard = isinstance(iter_expr, ast.Set) or (
+            isinstance(iter_expr, ast.Call)
+            and isinstance(iter_expr.func, ast.Name)
+            and iter_expr.func.id in ("set", "frozenset")
+        )
+        if hazard:
+            yield self.finding(
+                ctx, node.lineno if hasattr(node, "lineno")
+                else iter_expr.lineno,
+                "iteration over a set — order is hash-seed-dependent, so "
+                "any value built from it differs across processes "
+                "(PYTHONHASHSEED) and replays; sort it first "
+                "(`sorted(...)`)",
+            )

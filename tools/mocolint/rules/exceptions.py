@@ -1,0 +1,75 @@
+"""R1/R2: silent exception swallowing (migrated from the monolith).
+
+The fault-tolerance subsystem only works if faults are VISIBLE: a bare
+`except:` eats KeyboardInterrupt/SystemExit and hides the preemption
+path; an `except Exception: pass` discards the very errors the
+retry/rollback machinery routes on.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.mocolint.registry import Rule, register
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _names(node: ast.expr | None):
+    if node is None:
+        return []
+    if isinstance(node, ast.Tuple):
+        return [n for elt in node.elts for n in _names(elt)]
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    return []
+
+
+def _silent(body: list[ast.stmt]) -> bool:
+    return all(
+        isinstance(stmt, ast.Pass)
+        or (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+        for stmt in body
+    )
+
+
+@register
+class BareExcept(Rule):
+    id = "R1"
+    title = "no bare `except:` handlers"
+    rationale = ("a bare handler eats KeyboardInterrupt/SystemExit and "
+                 "hides the preemption path")
+    node_types = (ast.ExceptHandler,)
+
+    def visit(self, node, ctx):
+        if node.type is None:
+            yield self.finding(
+                ctx, node.lineno,
+                "bare `except:` — name the exception types (a bare handler "
+                "hides SIGINT and the preemption path)",
+            )
+
+
+@register
+class BroadSilentSwallow(Rule):
+    id = "R2"
+    title = "no pass-only handlers over Exception/BaseException"
+    rationale = ("swallowing EVERYTHING silently is never a policy; narrow "
+                 "named exceptions stay legal")
+    node_types = (ast.ExceptHandler,)
+
+    def visit(self, node, ctx):
+        if node.type is None:
+            return
+        caught = BROAD & set(_names(node.type))
+        if caught and _silent(node.body):
+            yield self.finding(
+                ctx, node.lineno,
+                f"`except {'/'.join(sorted(caught))}` with a pass-only body "
+                "silently swallows every error — narrow the type or "
+                "handle/log it",
+            )

@@ -1,0 +1,43 @@
+"""R5: no numeric-literal process exits — the supervisor classifies
+deaths by exit code, so codes must come from the named constants in
+resilience/exitcodes.py (one source of truth)."""
+
+from __future__ import annotations
+
+import ast
+
+from tools.mocolint.registry import Rule, register
+
+
+def _is_exit_call(func: ast.expr) -> bool:
+    """Exactly the process-exit spellings: `sys.exit`, `os._exit`, the
+    bare builtins `exit`/`SystemExit`. NOT any method that happens to be
+    named exit (`parser.exit(2)` is argparse's API, not the protocol)."""
+    if isinstance(func, ast.Name):
+        return func.id in ("exit", "SystemExit")
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return (func.value.id == "sys" and func.attr == "exit") or \
+            (func.value.id == "os" and func.attr == "_exit")
+    return False
+
+
+@register
+class NumericExit(Rule):
+    id = "R5"
+    title = "no numeric-literal process exits"
+    rationale = ("a magic number silently forks the supervisor's exit-code "
+                 "classification protocol")
+    node_types = (ast.Call,)
+
+    def visit(self, node, ctx):
+        if not _is_exit_call(node.func) or not node.args:
+            return
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, int):
+            yield self.finding(
+                ctx, node.lineno,
+                "numeric-literal process exit — use the named constants in "
+                "resilience/exitcodes.py (the supervisor classifies deaths "
+                "by these codes; a magic number here silently forks the "
+                "protocol)",
+            )

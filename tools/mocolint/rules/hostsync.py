@@ -1,0 +1,108 @@
+"""R8: host-sync / recompile hazards inside jitted step-builder code.
+
+The bit-identical resume and serve contracts (PRs 1/3/5) assume the
+compiled step is ONE program with no host round-trips: a `.item()`,
+`float(arr)`, `np.asarray(...)`, `jax.device_get(...)` or
+`block_until_ready(...)` inside a traced function forces a device sync
+per step (and usually a silent constant-folding of a traced value), and
+Python branching on `.shape` of a traced value recompiles per shape.
+
+Scope: the step-builder modules (config `STEP_BUILDER_MODULES`), and
+within them only the bodies of functions that are actually traced — a
+`build_*` function's setup code runs on the host by design and may do
+all of the above freely. Traced-ness is the closure computed by
+`astutil.traced_functions` (passed to jit/shard_map/grad/..., decorated,
+or lexically nested inside such a function).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.mocolint.astutil import (
+    call_name,
+    dotted,
+    in_traced_scope,
+    traced_functions,
+)
+from tools.mocolint.registry import Rule, register
+
+# numpy calls that materialize a traced value on the host
+_NP_HOST = {"asarray", "array", "copy", "save", "frombuffer"}
+_NP_BASES = {"np", "numpy", "onp"}
+
+
+@register
+class HostSyncInTracedCode(Rule):
+    id = "R8"
+    title = "no host syncs / recompile hazards in jitted step code"
+    rationale = ("a host round-trip inside the compiled step stalls the "
+                 "device pipeline every step and silently constant-folds "
+                 "traced values; shape-dependent Python branching "
+                 "recompiles per shape")
+
+    def check_file(self, ctx):
+        traced = traced_functions(ctx.tree, ctx.parents)
+        if not traced:
+            return
+        for node in ast.walk(ctx.tree):
+            if not in_traced_scope(node, ctx.parents, traced):
+                continue
+            if isinstance(node, ast.Call):
+                yield from self._check_call(node, ctx)
+            elif isinstance(node, (ast.If, ast.While)):
+                yield from self._check_branch(node, ctx)
+
+    def _check_call(self, node, ctx):
+        func = node.func
+        name = call_name(func)
+        if name == "item" and isinstance(func, ast.Attribute):
+            yield self.finding(
+                ctx, node.lineno,
+                "`.item()` inside a traced function — a per-step device "
+                "sync; keep the value on device (or move this to the "
+                "driver after the step returns)",
+            )
+            return
+        if name in ("device_get", "block_until_ready"):
+            yield self.finding(
+                ctx, node.lineno,
+                f"`{dotted(func) or name}(...)` inside a traced function — "
+                "host materialization stalls the step pipeline; traced "
+                "code must stay on device",
+            )
+            return
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in _NP_BASES
+                and func.attr in _NP_HOST):
+            yield self.finding(
+                ctx, node.lineno,
+                f"`{func.value.id}.{func.attr}(...)` inside a traced "
+                "function — numpy materializes the traced value on the "
+                "host (silent constant-fold + per-step sync); use jnp",
+            )
+            return
+        if (isinstance(func, ast.Name) and func.id in ("float", "int")
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)):
+            yield self.finding(
+                ctx, node.lineno,
+                f"`{func.id}(...)` on a non-literal inside a traced "
+                "function — coercing a traced array to a Python scalar "
+                "forces a host sync (TracerConversionError at best, a "
+                "silent constant-fold at worst); use jnp casts",
+            )
+
+    def _check_branch(self, node, ctx):
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+                yield self.finding(
+                    ctx, node.lineno,
+                    "Python branch on `.shape` inside a traced function — "
+                    "each distinct shape compiles a new program (the serve "
+                    "bucket ladder exists precisely to bound this); branch "
+                    "with lax.cond or hoist the shape decision to build "
+                    "time",
+                )
+                return

@@ -1,0 +1,85 @@
+"""R4: every Prefetcher/epoch_loader construction must be closed.
+
+The staging threads and `depth` device batches leak otherwise. A
+construction returned directly is the factory pattern and exempt: the
+caller owns the close.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.mocolint.astutil import call_name
+from tools.mocolint.registry import Rule, register
+
+LOADER_FACTORIES = {"Prefetcher", "epoch_loader"}
+
+
+def _walk_shallow(node):
+    """Children of `node`, not descending into nested function/class
+    scopes (each has its own finally obligations)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        yield child
+        yield from _walk_shallow(child)
+
+
+@register
+class UnclosedLoader(Rule):
+    id = "R4"
+    title = "loader constructions need a close() in a finally"
+    rationale = ("an early break leaks the staging threads and the staged "
+                 "device batches for the life of the process")
+
+    def check_file(self, ctx):
+        scopes = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            yield from self._scope(scope, ctx)
+
+    def _scope(self, scope, ctx):
+        constructions: list[tuple[str | None, int]] = []
+        closed_in_finally: set[str] = set()
+        for node in _walk_shallow(scope):
+            if (isinstance(node, ast.Call)
+                    and call_name(node.func) in LOADER_FACTORIES):
+                parent = ctx.parent(node)
+                if isinstance(parent, ast.Return):
+                    continue  # factory pattern: the caller owns the close
+                if (isinstance(parent, ast.Assign)
+                        and len(parent.targets) == 1
+                        and isinstance(parent.targets[0], ast.Name)):
+                    constructions.append(
+                        (parent.targets[0].id, node.lineno)
+                    )
+                else:
+                    constructions.append((None, node.lineno))
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for call in ast.walk(stmt):
+                        if (isinstance(call, ast.Call)
+                                and isinstance(call.func, ast.Attribute)
+                                and call.func.attr in ("close",
+                                                       "close_quietly")
+                                and isinstance(call.func.value, ast.Name)):
+                            closed_in_finally.add(call.func.value.id)
+        for var, lineno in constructions:
+            if var is None:
+                yield self.finding(
+                    ctx, lineno,
+                    "Prefetcher/epoch_loader constructed without binding a "
+                    "name — the staging threads can never be close()d; bind "
+                    "it and close in a finally",
+                )
+            elif var not in closed_in_finally:
+                yield self.finding(
+                    ctx, lineno,
+                    f"`{var} = ...` builds a Prefetcher but no `finally` in "
+                    f"this function calls `{var}.close()`/"
+                    f"`{var}.close_quietly()` — an early break leaks the "
+                    "staging threads and the staged batches",
+                )

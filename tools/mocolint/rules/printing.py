@@ -1,0 +1,26 @@
+"""R3: bare print() bypasses the structured telemetry channel."""
+
+from __future__ import annotations
+
+import ast
+
+from tools.mocolint.registry import Rule, register
+
+
+@register
+class BarePrint(Rule):
+    id = "R3"
+    title = "no bare print() outside the sanctioned channels"
+    rationale = ("an event printed anywhere else bypasses log_event -> "
+                 "telemetry events.jsonl, so an external monitor can never "
+                 "consume it")
+    node_types = (ast.Call,)
+
+    def visit(self, node, ctx):
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            yield self.finding(
+                ctx, node.lineno,
+                "bare `print(...)` — route through utils.logging (log_event "
+                "for events, info for plain lines) so the structured "
+                "telemetry sinks see it",
+            )

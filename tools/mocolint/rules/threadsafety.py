@@ -1,0 +1,146 @@
+"""R10: heuristic write-write race detector for thread-owning classes.
+
+Scope: any class that spawns `threading.Thread(target=self.<method>)`.
+Within it, the worker side is that target method plus everything it
+reaches through `self.<m>()` calls; the public side is every other
+method except `__init__` (which runs before the thread exists). An
+attribute ASSIGNED on both sides is shared mutable state: every one of
+its write sites must be lexically inside `with self.<lock>` (any self
+attribute whose name contains lock/cond/mutex/sem) or it is a lost-update
+race — exactly the convention serve/batcher.py pins with `self._cond`.
+
+Deliberate limits (it is a heuristic, not an alias analysis): reads are
+not tracked (torn reads of counters are tolerated by the telemetry
+consumers), container mutation via method calls (`self._q.append`) is
+not tracked (the stdlib deque/Queue are internally locked), and only
+lexical `with` blocks count as holding the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.mocolint.astutil import call_name
+from tools.mocolint.registry import Rule, register
+
+_LOCKISH = re.compile(r"(lock|cond|mutex|sem)", re.IGNORECASE)
+
+
+def _self_attr(expr) -> str | None:
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return expr.attr
+    return None
+
+
+def _attr_writes(fn):
+    """(attr, lineno, node) for every `self.X = ...` / `self.X += ...`
+    in `fn`, including tuple-unpacking targets."""
+    out = []
+    for node in ast.walk(fn):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                attr = _self_attr(e)
+                if attr is not None:
+                    out.append((attr, node.lineno, node))
+    return out
+
+
+def _locked(node, fn, parents) -> bool:
+    """Is `node` lexically inside `with self.<lock-ish>` within `fn`?"""
+    cur = parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                expr = item.context_expr
+                attr = _self_attr(expr)
+                if attr is None and isinstance(expr, ast.Call):
+                    attr = _self_attr(expr.func)
+                if attr is not None and _LOCKISH.search(attr):
+                    return True
+        cur = parents.get(cur)
+    return False
+
+
+@register
+class ThreadSharedWrites(Rule):
+    id = "R10"
+    title = "shared attributes of thread-owning classes write under a lock"
+    rationale = ("an attribute assigned from both the worker thread and a "
+                 "public method without the lock is a lost-update race "
+                 "that only load reveals")
+    node_types = (ast.ClassDef,)
+
+    def visit(self, node, ctx):
+        yield from self._check_class(node, ctx)
+
+    def _check_class(self, cls, ctx):
+        methods = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if not methods:
+            return
+        roots = self._worker_roots(cls, methods)
+        if not roots:
+            return
+        # closure over self.<m>() calls
+        edges = {
+            name: {
+                call_name(c.func)
+                for c in ast.walk(fn)
+                if isinstance(c, ast.Call)
+                and _self_attr(c.func) in methods
+            }
+            for name, fn in methods.items()
+        }
+        worker = set()
+        frontier = list(roots)
+        while frontier:
+            m = frontier.pop()
+            if m in worker:
+                continue
+            worker.add(m)
+            frontier.extend(edges.get(m, set()) & set(methods) - worker)
+        public = set(methods) - worker - {"__init__"}
+        writes = {name: _attr_writes(fn) for name, fn in methods.items()}
+        worker_attrs = {a for m in worker for a, _, _ in writes[m]}
+        public_attrs = {a for m in public for a, _, _ in writes[m]}
+        shared = worker_attrs & public_attrs
+        if not shared:
+            return
+        for side, names in (("worker", worker), ("public", public)):
+            for m in sorted(names):
+                fn = methods[m]
+                for attr, lineno, node in writes[m]:
+                    if attr in shared and not _locked(node, fn, ctx.parents):
+                        other = "a public method" if side == "worker" \
+                            else "the worker thread"
+                        yield self.finding(
+                            ctx, lineno,
+                            f"`self.{attr}` is written here ({side} method "
+                            f"`{cls.name}.{m}`) and from {other} — both "
+                            "sides race without `with self.<lock>` around "
+                            "the write (lost updates under load)",
+                        )
+
+    def _worker_roots(self, cls, methods):
+        roots = set()
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node.func) == "Thread"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    attr = _self_attr(kw.value)
+                    if attr in methods:
+                        roots.add(attr)
+        return roots

@@ -1,0 +1,85 @@
+"""Inline suppression comments.
+
+Syntax, anywhere in a line:
+
+    # mocolint: disable=R8            one rule
+    # mocolint: disable=R8,R10        several
+    # mocolint: disable=all           everything on the covered line
+
+Coverage: a trailing comment (code before the `#`) covers findings on ITS
+OWN line; a standalone comment line covers the NEXT line. That is the
+whole contract — no block/file scopes, so every suppression sits beside
+the code it excuses and carries its rationale in the same comment.
+
+Suppressions that cover no finding are themselves reported (rule `SUP`):
+a stale suppression is how a regressing rule goes quiet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import re
+import tokenize
+
+# rule list stops at the first token that is not `id` or `,` — trailing
+# prose in the same comment is the rationale, not more ids
+_PATTERN = re.compile(
+    r"#\s*mocolint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int            # line the comment sits on (1-based)
+    covers: int          # line whose findings it suppresses
+    rules: frozenset[str]  # rule ids, or {"all"}
+    used: bool = False
+
+    def matches(self, rule_id: str, line: int) -> bool:
+        return line == self.covers and ("all" in self.rules
+                                        or rule_id in self.rules)
+
+
+def scan(source: str) -> list[Suppression]:
+    """Real COMMENT tokens only (tokenize, not line regex): the syntax
+    quoted inside a docstring — this package documents itself — must not
+    create suppressions."""
+    out: list[Suppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out  # the engine reports the file as unparseable anyway
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _PATTERN.search(tok.string)
+        if not m:
+            continue
+        rules = frozenset(
+            r.strip() for r in m.group(1).split(",") if r.strip()
+        )
+        if not rules:
+            continue
+        line = tok.start[0]
+        standalone = tok.line.lstrip().startswith("#")
+        out.append(Suppression(line=line, covers=line + 1 if standalone
+                               else line, rules=rules))
+    return out
+
+
+def apply(findings, suppressions):
+    """Split findings into (kept, suppressed), marking used suppressions."""
+    kept, suppressed = [], []
+    for f in findings:
+        hit = None
+        for s in suppressions:
+            if s.matches(f.rule, f.line):
+                hit = s
+                break
+        if hit is None:
+            kept.append(f)
+        else:
+            hit.used = True
+            suppressed.append(f)
+    return kept, suppressed
