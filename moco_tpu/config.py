@@ -170,6 +170,27 @@ class PretrainConfig:
                                       # table (telemetry/mfu.py; unknown
                                       # hardware ⇒ MFU omitted, never
                                       # fabricated)
+    # distributed tracing + on-demand profiling (telemetry/trace.py;
+    # ISSUE 8 — see README "Tracing & profiling")
+    trace_mode: str = "off"           # "off" (capture windows still
+                                      # armable) | "steps" (one span per
+                                      # step / staged batch / supervisor
+                                      # launch) | "full" (+ worker decode
+                                      # slices, H2D puts, phase segments)
+    trace_capture_steps: int = 50     # capture-window length, in steps:
+                                      # SIGUSR1 / trace.trigger / anomaly
+                                      # detectors elevate to full detail
+                                      # (+ optional device trace) for this
+                                      # many steps
+    trace_capture_budget: int = 3     # max capture windows per run (auto-
+                                      # triggers can never profile-storm a
+                                      # multi-day run; 0 = captures off)
+    trace_slow_step_k: float = 3.0    # arm a capture when step_s (or the
+                                      # data phase) exceeds k × its own
+                                      # rolling p95
+    trace_device_profile: bool = False  # capture windows also record a
+                                      # jax.profiler device trace into
+                                      # <telemetry_dir>/traces/
     ckpt_dir: str = "checkpoints"
     ckpt_every_epochs: int = 1
     resume: str = ""                  # path | "auto"
@@ -258,6 +279,28 @@ class PretrainConfig:
             raise ValueError(
                 f"grad_sync_demo_beta must be in [0, 1), got "
                 f"{self.grad_sync_demo_beta}"
+            )
+        # tracing knobs (ISSUE 8): literals kept in sync with
+        # telemetry/trace.TRACE_MODES — config stays importable without
+        # the telemetry stack loaded
+        if self.trace_mode not in ("off", "steps", "full"):
+            raise ValueError(
+                f"unknown trace_mode {self.trace_mode!r}; choose from "
+                "off/steps/full"
+            )
+        if self.trace_capture_steps < 1:
+            raise ValueError(
+                f"trace_capture_steps must be >= 1, got "
+                f"{self.trace_capture_steps}"
+            )
+        if self.trace_capture_budget < 0:
+            raise ValueError(
+                f"trace_capture_budget must be >= 0, got "
+                f"{self.trace_capture_budget}"
+            )
+        if self.trace_slow_step_k <= 1.0:
+            raise ValueError(
+                f"trace_slow_step_k must be > 1, got {self.trace_slow_step_k}"
             )
 
     def replace(self, **kw) -> "PretrainConfig":
@@ -364,6 +407,14 @@ class ServeConfig:
     # observability (same events.jsonl stream as training)
     telemetry_dir: str = ""           # "" = telemetry off
     snapshot_every: int = 25          # serve-record cadence, in batches
+    # distributed tracing (ISSUE 8): request/flush spans + capture windows
+    trace_mode: str = "off"           # off | steps | full (README table)
+    trace_capture_steps: int = 50     # capture-window length, in FLUSHED
+                                      # batches (the serve tick unit)
+    trace_capture_budget: int = 3     # max capture windows per process
+    trace_shed_spike: int = 8         # arm a capture when this many
+                                      # overload sheds land within 5 s
+                                      # (0 = shed-spike detector off)
     # optional kNN-classify endpoint over a precomputed feature bank
     knn_bank: str = ""                # npz with `features` [N,D] + `labels` [N]
     knn_k: int = 200
@@ -389,6 +440,17 @@ class ServeConfig:
         if self.embed_cache_mb < 0:
             raise ValueError(
                 f"embed_cache_mb must be >= 0, got {self.embed_cache_mb}"
+            )
+        if self.trace_mode not in ("off", "steps", "full"):
+            raise ValueError(
+                f"unknown trace_mode {self.trace_mode!r}; choose from "
+                "off/steps/full"
+            )
+        if self.trace_capture_steps < 1 or self.trace_capture_budget < 0 \
+                or self.trace_shed_spike < 0:
+            raise ValueError(
+                "trace_capture_steps must be >= 1, trace_capture_budget "
+                "and trace_shed_spike >= 0"
             )
 
     def replace(self, **kw) -> "ServeConfig":

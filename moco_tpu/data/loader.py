@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from moco_tpu.parallel.mesh import DATA_AXIS
 from moco_tpu.resilience.chaos import active_chaos
+from moco_tpu.telemetry.trace import null_tracer
 from moco_tpu.utils.logging import log_event
 
 
@@ -130,9 +131,17 @@ class Prefetcher:
     def __init__(self, dataset, indices: np.ndarray, batch_per_host: int, mesh: Mesh,
                  depth: int = 2, retries: int = 3, backoff_secs: float = 0.5,
                  join_timeout: float = 5.0, workers: int = 1, stats=None,
-                 trim_h2d: bool = False):
+                 trim_h2d: bool = False, tracer=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        # span layer (ISSUE 8): the coordinator stamps one `stage_batch`
+        # span per batch; its staging workers and the per-shard H2D puts
+        # continue it as detail spans. The null tracer keeps the hot path
+        # branch-free when tracing is off. The coordinator THREAD has no
+        # span stack of its own, so its batch spans parent under whatever
+        # span the CONSTRUCTING thread held (the driver's context).
+        self._tracer = tracer if tracer is not None else null_tracer()
+        self._trace_parent = self._tracer.current_context()
         self.dataset = dataset
         self.indices = indices
         self.batch = batch_per_host
@@ -173,9 +182,14 @@ class Prefetcher:
                 task = self._tasks.get(timeout=0.1)
             except queue.Empty:
                 continue
-            b, lo, hi, idx, canvas, collector = task
+            b, lo, hi, idx, canvas, collector, trace_ctx = task
             try:
-                self._read_slice_into(b, idx, canvas, lo, hi)
+                # detail span continuing the coordinator's stage_batch span
+                # (explicit parent: thread-locals don't cross threads)
+                with self._tracer.span("decode_slice", cat="input",
+                                       detail=True, parent=trace_ctx,
+                                       batch=b, lo=lo, hi=hi):
+                    self._read_slice_into(b, idx, canvas, lo, hi)
                 collector.done_ok(lo)
             except BaseException as e:  # routed, not swallowed: the
                 # coordinator re-raises (or exits quietly on close)
@@ -234,10 +248,13 @@ class Prefetcher:
         try:
             for b in range(self.num_batches):
                 t0 = time.perf_counter()
-                if self.workers > 1:
-                    item = self._stage_batch_parallel(b)
-                else:
-                    item = self._stage_to_device(self._read_batch(b))
+                with self._tracer.span("stage_batch", cat="input",
+                                       parent=self._trace_parent,
+                                       batch=b) as sp:
+                    if self.workers > 1:
+                        item = self._stage_batch_parallel(b, sp)
+                    else:
+                        item = self._stage_to_device(self._read_batch(b))
                 if item is None:  # close() during staging
                     return
                 if not self._put(item):
@@ -311,10 +328,12 @@ class Prefetcher:
             (self.batch * c // w, self.batch * (c + 1) // w) for c in range(w)
         ], False
 
-    def _stage_batch_parallel(self, b: int):
+    def _stage_batch_parallel(self, b: int, span=None):
         """Fan one batch out to the staging workers; start per-shard H2D as
         aligned sub-slices complete; return the assembled device tuple (or
-        None when close() interrupted the batch)."""
+        None when close() interrupted the batch). `span` is the batch's
+        `stage_batch` trace span — its context rides each worker task so
+        the decode-slice detail spans parent under it."""
         if not hasattr(self, "_pool_built"):
             # the first batch doubles as shape discovery for the canvas
             # pool: stage it through the single-call path (bit-identical by
@@ -339,8 +358,10 @@ class Prefetcher:
         batch_idx = self.indices[b * self.batch : (b + 1) * self.batch]
         collector = _BatchCollector()
         chunks, aligned = self._chunks()
+        trace_ctx = span.context() if span is not None else None
         for lo, hi in chunks:
-            self._tasks.put((b, lo, hi, batch_idx[lo:hi], canvas, collector))
+            self._tasks.put((b, lo, hi, batch_idx[lo:hi], canvas, collector,
+                             trace_ctx))
         early = (self._early_put_plan()
                  if aligned and not self.trim_h2d else None)
         chunk_hi_of = dict(chunks)
@@ -365,9 +386,14 @@ class Prefetcher:
                 chunk_hi = chunk_hi_of[chunk_lo]
                 for dev, (r0, r1) in early:
                     if r0 >= chunk_lo and r1 <= chunk_hi:
-                        shard_arrays[dev] = jax.device_put(
-                            self._host_view(canvas.imgs[r0:r1]), dev
-                        )
+                        # detail span: the coordinator thread holds the
+                        # stage_batch span, so parenting is automatic
+                        with self._tracer.span("h2d_shard", cat="input",
+                                               detail=True, batch=b,
+                                               rows=f"{r0}:{r1}"):
+                            shard_arrays[dev] = jax.device_put(
+                                self._host_view(canvas.imgs[r0:r1]), dev
+                            )
         if err is not None:
             self._free.put(canvas)
             raise err
@@ -573,6 +599,7 @@ def epoch_loader(
     dataset, epoch: int, seed: int, global_batch: int, mesh: Mesh,
     skip_batches: int = 0, retries: int = 3, backoff_secs: float = 0.5,
     depth: int = 2, workers: int = 1, stats=None, trim_h2d: bool = False,
+    tracer=None,
 ) -> Prefetcher:
     """One epoch of sharded batches (sampler.set_epoch + DataLoader in one).
 
@@ -589,4 +616,5 @@ def epoch_loader(
         local = local[skip_batches * per_host:]
     return Prefetcher(dataset, local, per_host, mesh,
                       depth=depth, retries=retries, backoff_secs=backoff_secs,
-                      workers=workers, stats=stats, trim_h2d=trim_h2d)
+                      workers=workers, stats=stats, trim_h2d=trim_h2d,
+                      tracer=tracer)

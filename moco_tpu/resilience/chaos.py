@@ -67,6 +67,14 @@ class ChaosPlan:
                                             # heartbeat-staleness kill exists
                                             # for. The process sleeps until
                                             # killed from outside.
+    slow_at_step: int | None = None         # sleep slow_ms inside step k's
+                                            # timer window: a deterministic
+                                            # step-time blowout for the
+                                            # slow-step capture detector
+                                            # (ISSUE 8) — and for proving
+                                            # the watchdog flags without a
+                                            # kill
+    slow_ms: int = 1000                     # how long the slow step stalls
     nan_at_step: int | None = None          # poison the reported loss at step k
     nan_count: int = 1                      # re-poison step k on re-traversal
                                             # up to this many times (>1 models
@@ -134,6 +142,19 @@ class ChaosPlan:
             while True:
                 time.sleep(3600.0)
 
+    def maybe_slow(self, step: int) -> None:
+        """Stall the configured step by `slow_ms` (fire-once): an injected
+        slow step that every layer sees for real — the phase timer books a
+        step_s blowout, the anomaly detector arms a capture window, the
+        heartbeat's `last_step_ms` spikes. A stall, not a hang: the step
+        completes and the run proceeds, so no watchdog/supervisor kill."""
+        if self.slow_at_step == step and self._fire_once("slow"):
+            log_event(
+                "chaos",
+                f"injecting {self.slow_ms} ms slow step at step {step}",
+            )
+            time.sleep(self.slow_ms / 1e3)
+
     def maybe_nan(self, step: int) -> bool:
         """True at the configured step (the first `nan_count` traversals of
         it): the caller replaces the step's reported loss with NaN — the
@@ -173,6 +194,8 @@ _INT_FIELDS = (
     "sigterm_at_step",
     "kill_at_step",
     "freeze_at_step",
+    "slow_at_step",
+    "slow_ms",
     "nan_at_step",
     "nan_count",
     "loader_error_at_batch",

@@ -55,6 +55,11 @@ from moco_tpu.resilience.exitcodes import (
     EXIT_SERVE_BIND,
     USAGE_ERROR,
 )
+# pure-stdlib by contract (mocolint R12; the lazy telemetry __init__ keeps
+# this import numpy/jax-free): the supervisor is the trace ROOT — it mints
+# the run id, stamps the child's env, and its launch/kill spans join the
+# same timeline the child writes
+from moco_tpu.telemetry.trace import Tracer
 from moco_tpu.utils.logging import log_event
 
 EVENTS_FILENAME = "events.jsonl"
@@ -375,6 +380,17 @@ class Supervisor:
         # exists to prevent. Tests pass an explicit seed for determinism.
         self._rng = random.Random(seed)
         self._now = time_fn
+        # trace root (ISSUE 8): one run_id for the whole supervised run
+        # (inherited from MOCO_TPU_RUN_ID when an orchestrator set one);
+        # every child launch gets the ids via env, every supervisor
+        # incident record carries them, and the supervisor's own spans
+        # (one per child lifetime) land in the shared spans.jsonl.
+        # Supervisor spans always record: a handful per launch is free,
+        # and a timeline with the children but not their supervisor would
+        # bury exactly the restart/kill context it exists to show.
+        self.tracer = Tracer(telemetry_dir, "steps", proc="supervisor")
+        self.run_id = self.tracer.run_id
+        self._child_capturing = False
         self._budget = self.policy.max_restarts
         self._consecutive_failures = 0
         self._ever_beat = False  # any beat in any launch: distinguishes a
@@ -384,7 +400,8 @@ class Supervisor:
     # -- structured incidents (same stream the child writes) ----------------
     def _emit(self, event: str, **fields) -> None:
         record = {"v": 1, "t": round(time.time(), 3), "kind": "supervisor",
-                  "event": event}
+                  "event": event, "run_id": self.run_id,
+                  "trace_id": self.tracer.trace_id}
         record.update(fields)
         self.incidents.append(record)
         os.makedirs(self.telemetry_dir, exist_ok=True)
@@ -454,10 +471,16 @@ class Supervisor:
         # telemetry dir — the log (and the first incident record) must not
         # depend on the child having run
         os.makedirs(os.path.dirname(self.child_log_path) or ".", exist_ok=True)
+        # trace propagation (ISSUE 8): the child's tracer adopts this
+        # run_id and parents its root spans under the CURRENT supervisor
+        # span (the per-launch `child` span run() holds open) — one
+        # trace_id from supervisor through driver to staging worker
+        env = dict(os.environ if self.env is None else self.env)
+        env.update(self.tracer.child_env())
         log_file = open(self.child_log_path, "ab")
         try:
             child = subprocess.Popen(
-                argv, stdout=log_file, stderr=subprocess.STDOUT, env=self.env
+                argv, stdout=log_file, stderr=subprocess.STDOUT, env=env
             )
         finally:
             # the child holds its own descriptor; keeping ours open would
@@ -468,6 +491,8 @@ class Supervisor:
         return child
 
     def _kill_for_hang(self, child: subprocess.Popen, stale_for: float) -> None:
+        self.tracer.instant("hang_kill", cat="supervisor", pid=child.pid,
+                            stale_secs=round(stale_for, 3))
         self._emit("kill", pid=child.pid, reason="heartbeat_stale",
                    stale_secs=round(stale_for, 3), phase="sigterm")
         child.send_signal(signal.SIGTERM)
@@ -526,6 +551,12 @@ class Supervisor:
                             note="wrapper command? beats accepted by "
                                  "freshness; progress checks unaffected",
                         )
+                if mine or fresh:
+                    # same staleness guard as the beat bookkeeping: a
+                    # stale file from the PREVIOUS incarnation (which may
+                    # have died mid-capture) must not fabricate
+                    # "currently profiling" transitions for this child
+                    self._note_trace_state(hb)
             window = (self.policy.heartbeat_stale_secs
                       if beat_phase == "step"
                       else self.policy.startup_grace_secs)
@@ -548,25 +579,71 @@ class Supervisor:
                     continue
                 self._kill_for_hang(child, stale_for)
                 return True
+        if hang_detection:
+            # one post-exit read: a short capture window (or a child that
+            # DIED while capturing — the interesting case) must not slip
+            # between two polls unseen. Same mine-or-fresh guard: a child
+            # that never beat leaves the previous incarnation's file.
+            hb = read_heartbeat(self.heartbeat_path)
+            if hb is not None and (
+                    hb.get("pid") == child.pid
+                    or (isinstance(hb.get("t"), (int, float))
+                        and hb["t"] > launched_wall)):
+                self._note_trace_state(hb)
         return False
+
+    def _note_trace_state(self, hb: dict) -> None:
+        """"Currently profiling" surfacing (ISSUE 8 satellite): the beat
+        carries the child's capture state, so the operator watching
+        supervisor output learns a capture started/ended without reading
+        events.jsonl. Emits one `child_trace` record per transition."""
+        trace_state = hb.get("trace")
+        if not isinstance(trace_state, dict):
+            return
+        capturing = bool(trace_state.get("capturing"))
+        if capturing == self._child_capturing:
+            return
+        self._child_capturing = capturing
+        self._emit(
+            "child_trace",
+            capturing=capturing,
+            step=hb.get("step"),
+            captures_used=trace_state.get("captures_used"),
+            capture_budget=trace_state.get("capture_budget"),
+        )
 
     # -- main loop -----------------------------------------------------------
     def run(self) -> SupervisorResult:
+        try:
+            return self._run()
+        finally:
+            self.tracer.close()  # land any buffered supervisor spans
+
+    def _run(self) -> SupervisorResult:
         attempt = 0
         classifications: list[str] = []
         marker_before = self._progress_marker()
         while True:
             if self.ckpt_dir and attempt > 0:
                 preflight_resume(self.ckpt_dir, emit=self._emit)
-            child = self._launch(attempt)
-            hang_killed = self._monitor(child)
-            rc = child.returncode
-            cls, detail = classify_exit(
-                rc,
-                hang_killed=hang_killed,
-                events_tail=read_events_tail(self.events_path),
-                oom_rss_bytes=self.policy.oom_rss_bytes,
-            )
+            # one span per child LIFETIME (launch → death): the child's own
+            # root spans parent under it via the env stamped in _launch,
+            # so the merged timeline nests each incarnation's work beneath
+            # the supervisor's view of it
+            with self.tracer.span("child", cat="supervisor",
+                                  attempt=attempt) as child_span:
+                child = self._launch(attempt)
+                self._child_capturing = False
+                hang_killed = self._monitor(child)
+                rc = child.returncode
+                cls, detail = classify_exit(
+                    rc,
+                    hang_killed=hang_killed,
+                    events_tail=read_events_tail(self.events_path),
+                    oom_rss_bytes=self.policy.oom_rss_bytes,
+                )
+                child_span.set(pid=child.pid, returncode=rc,
+                               classification=cls)
             marker_now = self._progress_marker()
             progressed = marker_now > marker_before
             marker_before = max(marker_before, marker_now)

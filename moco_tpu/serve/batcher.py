@@ -36,6 +36,8 @@ from collections import deque
 
 import numpy as np
 
+from moco_tpu.telemetry.trace import SpikeDetector, null_tracer
+
 
 class RejectionError(Exception):
     """A request that got a structured DECISION instead of a result.
@@ -93,14 +95,19 @@ def validate_buckets(buckets) -> tuple[int, ...]:
 
 
 class PendingRequest:
-    """One queued request: payload in, exactly-one-of (result, error) out."""
+    """One queued request: payload in, exactly-one-of (result, error) out.
+    `enqueue_wall` is the wall-clock twin of the monotonic `enqueue_t` —
+    the trace layer records the request's admission→resolve span
+    retroactively at resolve time (ISSUE 8), and cross-process timelines
+    merge on wall-clock."""
 
-    __slots__ = ("payload", "enqueue_t", "deadline_t", "result", "error",
-                 "_done")
+    __slots__ = ("payload", "enqueue_t", "enqueue_wall", "deadline_t",
+                 "result", "error", "_done")
 
     def __init__(self, payload, enqueue_t: float, deadline_t: float):
         self.payload = payload
         self.enqueue_t = enqueue_t
+        self.enqueue_wall = time.time()
         self.deadline_t = deadline_t
         self.result = None
         self.error: Exception | None = None
@@ -146,6 +153,8 @@ class MicroBatcher:
         default_deadline_ms: float = 2000.0,
         on_batch=None,
         name: str = "embed",
+        tracer=None,
+        shed_spike_min: int = 8,
     ):
         self.buckets = validate_buckets(buckets)
         if max_queue < self.buckets[-1]:
@@ -159,6 +168,12 @@ class MicroBatcher:
         self.max_queue = int(max_queue)
         self._default_deadline_s = float(default_deadline_ms) / 1e3
         self._on_batch = on_batch
+        # tracing (ISSUE 8): flush/engine spans + retroactive per-request
+        # spans, and the shed-spike detector arming a budgeted capture
+        # window. The null tracer keeps the request path branch-free.
+        self._tracer = tracer if tracer is not None else null_tracer()
+        self._shed_spike = SpikeDetector(min_events=shed_spike_min)
+        self._flush_seq = 0
         self._queue: deque[PendingRequest] = deque()
         self._cond = threading.Condition()
         self._draining = False
@@ -186,21 +201,35 @@ class MicroBatcher:
         if deadline_s is None:
             deadline_s = self._default_deadline_s
         pending = PendingRequest(payload, now, now + deadline_s)
+        queue_len = -1
         with self._cond:
             if self._draining or self._closed:
                 raise DrainingError("service is draining; not accepting work")
             if len(self._queue) >= self.max_queue:
                 self.shed_overload += 1
-                # crude but honest hint: full queues ahead of this request
-                # each take at least one flush window to clear
-                depth_batches = 1 + len(self._queue) // self.buckets[-1]
-                raise OverloadedError(
-                    f"admission queue full ({self.max_queue})",
-                    retry_after_ms=round(depth_batches * self._flush_s * 1e3, 1),
-                )
-            self.submitted += 1
-            self._queue.append(pending)
-            self._cond.notify_all()
+                queue_len = len(self._queue)
+            else:
+                self.submitted += 1
+                self._queue.append(pending)
+                self._cond.notify_all()
+        if queue_len >= 0:
+            # tracer work OUTSIDE the admission lock: a span-ring flush is
+            # a file write, and an overload storm is exactly when the lock
+            # must stay cheap — "shed, never stall" includes not stalling
+            # the OTHER submitters on shed bookkeeping
+            if self._shed_spike.note():
+                # a shed SPIKE (vs a lone shed) is the moment worth a
+                # profile: arm the capture window, budget-bounded
+                self._tracer.maybe_autocapture("shed_spike")
+            self._tracer.instant("shed_overload", cat="serve",
+                                 queue=queue_len)
+            # crude but honest hint: full queues ahead of this request
+            # each take at least one flush window to clear
+            depth_batches = 1 + queue_len // self.buckets[-1]
+            raise OverloadedError(
+                f"admission queue full ({self.max_queue})",
+                retry_after_ms=round(depth_batches * self._flush_s * 1e3, 1),
+            )
         return pending
 
     @property
@@ -243,6 +272,8 @@ class MicroBatcher:
 
     def _execute(self, batch: list[PendingRequest]) -> None:
         now = time.monotonic()
+        self._flush_seq += 1
+        seq = self._flush_seq  # joins request spans to their flush span
         live, expired = [], []
         for p in batch:
             (live if p.deadline_t > now else expired).append(p)
@@ -251,23 +282,32 @@ class MicroBatcher:
                 f"deadline passed after {now - p.enqueue_t:.3f}s in queue",
                 queued_ms=round((now - p.enqueue_t) * 1e3, 1),
             ))
+            self._request_span(p, now, "deadline_exceeded", seq)
         with self._cond:
             self.shed_deadline += len(expired)
         if not live:
             return
         bucket = bucket_for(len(live), self.buckets)
-        try:
-            out = np.asarray(self._run_batch(
-                np.stack([p.payload for p in live])
-            ))
-        except Exception as e:  # executor failure: every rider sees it
-            for p in live:
-                p.resolve(error=e)
-            with self._cond:
-                self.batch_errors += 1
-            return
-        for p, row in zip(live, out):
-            p.resolve(result=np.asarray(row))
+        with self._tracer.span("flush_batch", cat="serve", n=len(live),
+                               bucket=bucket, seq=seq):
+            try:
+                with self._tracer.span("engine", cat="serve", detail=True,
+                                       bucket=bucket):
+                    out = np.asarray(self._run_batch(
+                        np.stack([p.payload for p in live])
+                    ))
+            except Exception as e:  # executor failure: every rider sees it
+                for p in live:
+                    p.resolve(error=e)
+                    self._request_span(p, time.monotonic(), "batch_error",
+                                       seq)
+                with self._cond:
+                    self.batch_errors += 1
+                return
+            done = time.monotonic()
+            for p, row in zip(live, out):
+                p.resolve(result=np.asarray(row))
+                self._request_span(p, done, "ok", seq)
         wait_s = now - live[0].enqueue_t
         with self._cond:
             self.completed += len(live)
@@ -275,6 +315,18 @@ class MicroBatcher:
             self.occupancy_sum += len(live) / bucket
         if self._on_batch is not None:
             self._on_batch(len(live), bucket, wait_s)
+
+    def _request_span(self, p: PendingRequest, t_mono: float, outcome: str,
+                      seq: int) -> None:
+        """Retroactive admission→resolve span for one request, recorded
+        only at `full` detail (or inside a capture window): under load the
+        per-request spans are the bulk of the volume, so the coarse level
+        keeps just the flush spans. Correlate with the executing flush via
+        the shared `seq` attr."""
+        self._tracer.record_span(
+            "request", p.enqueue_wall, t_mono - p.enqueue_t, cat="serve",
+            detail=True, outcome=outcome, seq=seq,
+        )
 
     # -- shutdown ------------------------------------------------------------
     def drain(self, timeout_s: float = 60.0) -> bool:

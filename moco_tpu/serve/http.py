@@ -79,11 +79,20 @@ def _make_handler(service):
 
         def do_GET(self):
             if self.path == "/healthz":
-                if service.draining:
-                    self._send(503, {"status": "draining"})
+                # trace state (ISSUE 8 satellite): a balancer/operator sees
+                # "currently profiling" straight from the health probe.
+                # `draining` read ONCE: a drain flipping between body and
+                # status would send a 503 whose body still says ok
+                draining = service.draining
+                trace = getattr(service, "trace_state", lambda: None)()
+                if draining:
+                    body = {"status": "draining"}
                 else:
-                    self._send(200, {"status": "ok",
-                                     "queue_depth": service.batcher.queue_depth})
+                    body = {"status": "ok",
+                            "queue_depth": service.batcher.queue_depth}
+                if trace is not None:
+                    body["trace"] = trace
+                self._send(503 if draining else 200, body)
             elif self.path == "/stats":
                 self._send(200, service.stats())
             else:

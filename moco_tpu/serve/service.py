@@ -50,6 +50,8 @@ class EmbedService:
         cache_mb: int = 0,
         registry=None,
         snapshot_every: int = 25,
+        tracer=None,
+        shed_spike_min: int = 8,
         knn_bank: np.ndarray | None = None,
         knn_labels: np.ndarray | None = None,
         num_classes: int = 0,
@@ -70,6 +72,11 @@ class EmbedService:
         self._h_queue_wait = Histogram("serve_queue_wait_s",
                                        window=STATS_WINDOW)
         self._request_deadline_s = float(request_deadline_ms) / 1e3
+        # tracing (ISSUE 8): the batcher stamps request/flush/engine spans
+        # and arms shed-spike captures; the service ticks the capture
+        # window once per executed batch and surfaces the capture state on
+        # /healthz + /stats
+        self.tracer = tracer
         self.batcher = MicroBatcher(
             engine.embed,
             buckets=engine.buckets,
@@ -77,6 +84,8 @@ class EmbedService:
             max_queue=max_queue,
             default_deadline_ms=request_deadline_ms,
             on_batch=self._note_batch,
+            tracer=tracer,
+            shed_spike_min=shed_spike_min,
         )
         self._knn = None
         if knn_bank is not None:
@@ -172,6 +181,12 @@ class EmbedService:
     # -- telemetry -----------------------------------------------------------
     def _note_batch(self, n: int, bucket: int, wait_s: float) -> None:
         self._h_queue_wait.observe(wait_s)
+        if self.tracer is not None:
+            # one executed batch = one capture-window tick (the serve
+            # analogue of a train step); transitions land in events.jsonl
+            evt = self.tracer.tick(self.batcher.batches)
+            if evt is not None and self.registry is not None:
+                self.registry.emit("event", event="trace_capture", **evt)
         if (self.registry is not None
                 and self.batcher.batches % self.snapshot_every == 0):
             self.registry.emit("serve", **self.stats())
@@ -205,7 +220,15 @@ class EmbedService:
                 "entries": self.cache.entries,
                 "bytes": self.cache.cached_bytes,
             }
+        trace = self.trace_state()
+        if trace is not None:
+            out["trace"] = trace
         return out
+
+    def trace_state(self) -> dict | None:
+        """Capture-window state for /healthz and /stats ("currently
+        profiling?" without reading events.jsonl); None when untraced."""
+        return self.tracer.capture_state() if self.tracer is not None else None
 
     # -- shutdown ------------------------------------------------------------
     def drain(self, timeout_s: float = 60.0) -> bool:
@@ -225,4 +248,6 @@ class EmbedService:
         if self.registry is not None:
             self.registry.emit("serve", final=True, **self.stats())
             self.registry.flush()
+        if self.tracer is not None:
+            self.tracer.flush()  # land any buffered spans with the drain
         return completed

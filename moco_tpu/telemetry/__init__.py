@@ -1,60 +1,83 @@
-"""Structured run telemetry (ISSUE 2 tentpole).
+"""Structured run telemetry (ISSUE 2 tentpole) + distributed tracing
+(ISSUE 8).
 
 The measurement foundation every perf PR is judged against: step-phase
 timing (data/host/device), analytic-FLOPs MFU, HBM/host-memory tracking,
-pod-aggregated JSONL events, a heartbeat for external watchdogs, and the
-`log_event` bridge that lands resilience incidents in the same stream.
+pod-aggregated JSONL events, a heartbeat for external watchdogs, the
+`log_event` bridge that lands resilience incidents in the same stream,
+and the cross-process span layer (`telemetry/trace.py`) that joins
+supervisor, driver, staging workers and serve into one timeline.
 
-Offline consumer: `tools/telemetry_report.py` renders p50/p95/p99 step
+Offline consumers: `tools/telemetry_report.py` renders p50/p95/p99 step
 time, MFU, throughput, HBM high-water and incident counts from an
-events.jsonl. Schema notes: registry.py module docstring + README
-"Observability".
+events.jsonl; `tools/trace_report.py` merges spans + events into one
+Chrome-trace JSON. Schema notes: registry.py module docstring + README
+"Observability" / "Tracing & profiling".
+
+This __init__ is LAZY (PEP 562): the out-of-process supervisor imports
+`moco_tpu.telemetry.trace` — which executes this package body — and must
+stay importable without jax or numpy (mocolint R12 + the R11
+supervisor-stdlib-only boundary). Eagerly importing `pod`/`run` here
+would drag numpy (and, through the data package, jax) into every
+supervisor process; instead each public name resolves its submodule on
+first attribute access, so `from moco_tpu.telemetry import RunTelemetry`
+keeps working unchanged while `import moco_tpu.telemetry.trace` touches
+nothing heavy.
 """
 
-from moco_tpu.telemetry.device import DeviceMonitor, host_rss_bytes
-from moco_tpu.telemetry.mfu import (
-    MFUEstimator,
-    detect_peak_flops,
-    model_fwd_flops,
-    resnet_fwd_flops,
-    train_step_flops,
-    vit_fwd_flops,
-)
-from moco_tpu.telemetry.pod import POD_FIELDS, PodAggregator
-from moco_tpu.telemetry.registry import (
-    EVENTS_FILENAME,
-    HEARTBEAT_FILENAME,
-    SCHEMA_VERSION,
-    Counter,
-    Gauge,
-    Heartbeat,
-    Histogram,
-    MetricsRegistry,
-    percentiles_ms,
-)
-from moco_tpu.telemetry.run import RunTelemetry
-from moco_tpu.telemetry.timing import StepPhaseTimer
+from __future__ import annotations
 
-__all__ = [
-    "Counter",
-    "DeviceMonitor",
-    "EVENTS_FILENAME",
-    "Gauge",
-    "HEARTBEAT_FILENAME",
-    "Heartbeat",
-    "Histogram",
-    "MFUEstimator",
-    "MetricsRegistry",
-    "POD_FIELDS",
-    "PodAggregator",
-    "RunTelemetry",
-    "SCHEMA_VERSION",
-    "StepPhaseTimer",
-    "detect_peak_flops",
-    "host_rss_bytes",
-    "model_fwd_flops",
-    "percentiles_ms",
-    "resnet_fwd_flops",
-    "train_step_flops",
-    "vit_fwd_flops",
-]
+import importlib
+
+# public name -> submodule that defines it
+_EXPORTS = {
+    "DeviceMonitor": "device",
+    "host_rss_bytes": "device",
+    "MFUEstimator": "mfu",
+    "detect_peak_flops": "mfu",
+    "model_fwd_flops": "mfu",
+    "resnet_fwd_flops": "mfu",
+    "train_step_flops": "mfu",
+    "vit_fwd_flops": "mfu",
+    "POD_FIELDS": "pod",
+    "PodAggregator": "pod",
+    "EVENTS_FILENAME": "registry",
+    "HEARTBEAT_FILENAME": "registry",
+    "SCHEMA_VERSION": "registry",
+    "Counter": "registry",
+    "Gauge": "registry",
+    "Heartbeat": "registry",
+    "Histogram": "registry",
+    "MetricsRegistry": "registry",
+    "percentiles_ms": "registry",
+    "RunTelemetry": "run",
+    "StepPhaseTimer": "timing",
+    "Tracer": "trace",
+    "SlowSampleDetector": "trace",
+    "SpikeDetector": "trace",
+    "SPANS_FILENAME": "trace",
+    "TRIGGER_FILENAME": "trace",
+    "TRACES_DIRNAME": "trace",
+    "TRACE_MODES": "trace",
+    "null_tracer": "trace",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        submodule = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    value = getattr(
+        importlib.import_module(f"{__name__}.{submodule}"), name
+    )
+    globals()[name] = value  # cache: later accesses skip __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -160,11 +160,16 @@ class MetricsRegistry:
 
     `path` is the events file ("" / None disables the sink: instruments
     still aggregate — non-main pod hosts run exactly this way, feeding the
-    allgather without writing files)."""
+    allgather without writing files). `stamp` is a small dict merged into
+    EVERY record (ISSUE 8: the tracer's `run_id`/`trace_id`), so the flat
+    event stream joins the span timeline — explicit record fields win on
+    key collision."""
 
-    def __init__(self, path: str | None = None, flush_every: int = 50):
+    def __init__(self, path: str | None = None, flush_every: int = 50,
+                 stamp: dict | None = None):
         self._instruments: dict[str, Counter | Gauge | Histogram] = {}
         self._buffer: list[str] = []
+        self._stamp = dict(stamp) if stamp else None
         self._path = path or None
         self._file = None
         # emit/flush are called from the main step loop AND from log_event
@@ -224,6 +229,8 @@ class MetricsRegistry:
             # serialization work entirely — instruments still aggregate
             return False
         record = {"v": SCHEMA_VERSION, "t": round(time.time(), 3), "kind": kind}
+        if self._stamp:
+            record.update(self._stamp)
         record.update(fields)
         line = json.dumps(_json_safe(record), allow_nan=False)
         with self._lock:

@@ -20,6 +20,7 @@ everything else is host-side arithmetic and buffered writes.
 from __future__ import annotations
 
 import os
+import time
 
 from moco_tpu.telemetry.device import DeviceMonitor
 from moco_tpu.telemetry.mfu import MFUEstimator
@@ -32,6 +33,7 @@ from moco_tpu.telemetry.registry import (
 )
 from moco_tpu.data.stats import InputPipelineStats
 from moco_tpu.telemetry.timing import StepPhaseTimer
+from moco_tpu.telemetry.trace import SlowSampleDetector, Tracer, null_tracer
 from moco_tpu.utils import logging as mlog
 
 
@@ -45,9 +47,42 @@ class RunTelemetry:
         is_main = process_index == 0
         run_dir = config.telemetry_dir
         self.events_path = os.path.join(run_dir, EVENTS_FILENAME)
+        # span layer (ISSUE 8): process 0 only, like every file sink. The
+        # tracer exists even at trace_mode="off" — that is what makes the
+        # SIGUSR1 / trigger-file / anomaly capture windows reachable on a
+        # run that wasn't started with tracing on.
+        self.tracer = (
+            Tracer(
+                run_dir,
+                getattr(config, "trace_mode", "off"),
+                proc="driver",
+                capture_steps=getattr(config, "trace_capture_steps", 50),
+                capture_budget=getattr(config, "trace_capture_budget", 3),
+            )
+            if is_main else null_tracer()
+        )
+        self.tracer.install_signal()
+        if is_main and getattr(config, "trace_device_profile", False):
+            self.tracer.profiler_hooks = (_profiler_start, _profiler_stop)
+        # anomaly detectors arming the capture window (budgeted in the
+        # tracer): a slow step vs the rolling p95, and a staging stall
+        # seen as a data-phase blowout (the consumer side of an empty
+        # prefetch queue). Floors keep µs-scale noise on a healthy phase
+        # from ever tripping them.
+        # skip=3: the cold-compile/warmup steps are seconds-scale by
+        # design; left in the window they put k×p95 at compile scale and
+        # hide every later real anomaly. Higher input-stall floor: the
+        # first step after an epoch boundary legitimately waits on a fresh
+        # Prefetcher's spin-up — a sub-250 ms data wait is never the stall
+        # worth spending a capture budget on.
+        k = getattr(config, "trace_slow_step_k", 3.0)
+        self._slow_step = SlowSampleDetector(k=k, floor_s=0.005, skip=3)
+        self._input_stall = SlowSampleDetector(k=k, floor_s=0.25, skip=3)
         self.registry = MetricsRegistry(
             self.events_path if is_main else None,
             flush_every=config.telemetry_flush_steps,
+            stamp={"run_id": self.tracer.run_id,
+                   "trace_id": self.tracer.trace_id} if is_main else None,
         )
         self.heartbeat = (
             Heartbeat(os.path.join(run_dir, HEARTBEAT_FILENAME),
@@ -123,7 +158,40 @@ class RunTelemetry:
     # -- per-step ------------------------------------------------------------
     def on_step(self, step: int, phases: dict, throughput, loss=None) -> bool:
         """Emit one step record; returns True when this step flushed the
-        sink (the driver aligns ScalarWriter.flush with that cadence)."""
+        sink (the driver aligns ScalarWriter.flush with that cadence).
+
+        Everything this method does — record building, span recording,
+        capture-window ticks, detector checks — is measured and booked
+        back into the phase timer as the `telemetry` sub-phase, so the
+        phase-share report never blames the input pipeline for the span
+        layer's own cost (ISSUE 8 satellite)."""
+        t_tel0 = time.perf_counter()
+        # anomaly → capture window (budgeted): check BEFORE the step span
+        # records, so the capture's full-detail window starts as early as
+        # the step after the anomaly
+        # the anomaly event lands whenever the request was newly routed —
+        # including past the capture budget, where the tick below answers
+        # with one visible `denied` instead of a silent nothing
+        if self._slow_step.observe(phases["step_s"]):
+            if self.tracer.maybe_autocapture("slow_step"):
+                self.registry.emit(
+                    "event", event="trace_anomaly", anomaly="slow_step",
+                    step=int(step), step_s=round(phases["step_s"], 6),
+                    # pre-append snapshot: .p95() here would already
+                    # contain the anomalous sample and could equal it
+                    p95_s=round(self._slow_step.last_p95, 6),
+                )
+        if self._input_stall.observe(phases["data_s"]):
+            if self.tracer.maybe_autocapture("input_stall"):
+                self.registry.emit(
+                    "event", event="trace_anomaly", anomaly="input_stall",
+                    step=int(step), data_s=round(phases["data_s"], 6),
+                    p95_s=round(self._input_stall.last_p95, 6),
+                )
+        self.tracer.record_step(step, phases)
+        capture_evt = self.tracer.tick(step)
+        if capture_evt is not None:
+            self.registry.emit("event", event="trace_capture", **capture_evt)
         record = dict(step=int(step))
         for key, value in phases.items():
             record[key] = round(value, 6)
@@ -162,7 +230,21 @@ class RunTelemetry:
             # of telemetry_flush_steps — a 50-step flush cadence meant the
             # supervisor saw a "hang" of 50 step times. The time gate
             # (heartbeat_secs) keeps the atomic replace off the fast path.
-            self.heartbeat.maybe_beat(step, phase="step")
+            # `last_step_ms` + `trace` (ISSUE 8 satellite): the supervisor
+            # and /healthz read "currently profiling" and the latest step
+            # time straight from the beat, no events.jsonl scrape.
+            self.heartbeat.maybe_beat(
+                step, phase="step",
+                last_step_ms=round(phases["step_s"] * 1e3, 1),
+                trace=self.tracer.capture_state(),
+            )
+        # book everything this method cost (the tracer's tick/flush work
+        # ran inside this window, so the measurement already covers it;
+        # span flushes on the STAGING threads are concurrent with the
+        # step and deliberately not booked — they are not main-thread
+        # time) into the explicit telemetry sub-phase
+        self.tracer.consume_self_time()  # drop: contained in the window
+        self.timer.note_telemetry(time.perf_counter() - t_tel0)
         return flushed
 
     # -- pod sync (piggybacks on the resilience_sync_steps allgather) --------
@@ -197,6 +279,11 @@ class RunTelemetry:
             summary["hbm_peak_bytes"] = int(self._hbm_gauge.high_water)
         if self.input_stats.staged_batches:
             summary["input"] = self.input_stats.snapshot()
+        if self.tracer.captures_used or self.tracer.spans_recorded:
+            summary["trace"] = dict(
+                self.tracer.capture_state(),
+                spans_recorded=self.tracer.spans_recorded,
+            )
         summary.update(extra_summary)
         self.registry.emit("run_end", **summary)
         if self.heartbeat is not None:
@@ -207,5 +294,22 @@ class RunTelemetry:
             self.heartbeat.beat(
                 summary.get("last_step", self._step_hist.count),
                 phase="preempt_exit" if summary.get("preempted") else "run_end",
+                trace=self.tracer.capture_state(),
             )
         self.registry.close()
+        self.tracer.close()
+
+
+def _profiler_start(trace_dir: str) -> None:
+    """Capture-window device-trace hook (config: trace_device_profile).
+    Lazy jax import: trace.py itself must stay jax-free, so the hooks are
+    injected from this (already jax-coupled) module."""
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+
+
+def _profiler_stop() -> None:
+    import jax
+
+    jax.profiler.stop_trace()

@@ -25,6 +25,17 @@ A training step's wall time decomposes into:
               (CPU, and the relay on scalar transfers) both probes drain
               together and comm_s reads ~0 — the analytic sync-bytes/step
               in the `grad_sync` records is the backend-independent signal.
+  telemetry_s — span-layer/telemetry self-time (ISSUE 8 satellite fix):
+              record-keeping the telemetry stack itself paid inside this
+              step's window — span flushes, trigger-file polls, capture
+              transitions, the on_step bookkeeping. Booked explicitly via
+              `note_telemetry` and SUBTRACTED from the window it would
+              otherwise pollute, so a capture window (which makes the
+              span layer temporarily expensive on purpose) cannot
+              masquerade as a data/host-phase regression in the
+              phase-share report. In this driver the telemetry work runs
+              between one step's finish and the next step's loader wait,
+              so the polluted window is the NEXT step's `data_s`.
   step_s    — the whole iteration (data_s + host_s + meters + everything);
               on fenced steps it includes the fence wait.
 
@@ -53,6 +64,7 @@ class StepPhaseTimer:
         self._t_dispatch = None
         self._device_s = None
         self._comm_s = None
+        self._telemetry_s = 0.0
 
     def epoch_start(self) -> None:
         now = time.perf_counter()
@@ -60,6 +72,16 @@ class StepPhaseTimer:
         self._t_data = self._t_dispatch = None
         self._device_s = None
         self._comm_s = None
+        # telemetry time booked after the previous epoch's last step falls
+        # outside every step window — dropping it is correct, carrying it
+        # would over-subtract from the new epoch's first data phase
+        self._telemetry_s = 0.0
+
+    def note_telemetry(self, seconds: float) -> None:
+        """Book span-layer/telemetry self-time into the CURRENT iteration
+        window (the driver calls this right after its per-step telemetry
+        work, which runs between finish_step and the next loader wait)."""
+        self._telemetry_s += max(float(seconds), 0.0)
 
     def mark_data(self) -> None:
         self._t_data = time.perf_counter()
@@ -113,11 +135,19 @@ class StepPhaseTimer:
         t0 = self._t_iter if self._t_iter is not None else now
         t_data = self._t_data if self._t_data is not None else t0
         t_disp = self._t_dispatch if self._t_dispatch is not None else t_data
+        # carve the booked telemetry self-time OUT of the phase it landed
+        # in (the loader-wait window, see the class docstring) into its
+        # own bucket: data_s + host_s + telemetry_s still sums within
+        # step_s, and the phase-share report stops blaming the input
+        # pipeline for capture-window overhead
+        telemetry_s = min(self._telemetry_s, max(t_data - t0, 0.0))
         phases = {
             "step_s": now - t0,
-            "data_s": t_data - t0,
+            "data_s": max(t_data - t0 - telemetry_s, 0.0),
             "host_s": t_disp - t_data,
         }
+        if telemetry_s > 0.0:
+            phases["telemetry_s"] = telemetry_s
         if self._device_s is not None:
             phases["device_s"] = self._device_s
         if self._comm_s is not None:
@@ -126,4 +156,5 @@ class StepPhaseTimer:
         self._t_data = self._t_dispatch = None
         self._device_s = None
         self._comm_s = None
+        self._telemetry_s = 0.0
         return phases

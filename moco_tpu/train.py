@@ -575,6 +575,7 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                 backoff_secs=config.loader_backoff_secs,
                 depth=config.prefetch_depth, workers=config.staging_workers,
                 stats=input_stats, trim_h2d=config.h2d_trim,
+                tracer=telemetry.tracer if telemetry is not None else None,
             )
             end = time.perf_counter()
             if telemetry is not None:
@@ -609,6 +610,11 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                     if sentinel is not None:
                         sentinel.observe(global_step, metrics["loss"],
                                          pos=(epoch, i))
+                    if plan is not None:
+                        # slow-step drill (ISSUE 8): the sleep lands inside
+                        # THIS step's timer window, so the anomaly detector
+                        # sees a real step_s blowout end-to-end
+                        plan.maybe_slow(global_step)
                     watchdog.beat(global_step)
                     d_fail = getattr(dataset, "decode_failures", 0)
                     d_total = getattr(dataset, "decode_total", 0)

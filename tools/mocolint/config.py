@@ -164,9 +164,13 @@ STEP_BUILDER_MODULES = (
 
 DEFAULT_CONFIG = LintConfig(
     enabled=("R1", "R2", "R3", "R4", "R5", "R6", "R7",
-             "R8", "R9", "R10", "R11"),
+             "R8", "R9", "R10", "R11", "R12"),
     scopes={
         **_R1_R7_SCOPES,
+        # R12 (ISSUE 8): span context-manager discipline package-wide +
+        # the stdlib-only import diet of telemetry/trace.py (which the
+        # rule applies only to that file)
+        "R12": RuleScope(include=("moco_tpu/", "tools/", "bench.py")),
         # package contracts: the CLI scripts in tools/ print and exit(N)
         # by design, so the package-convention rules scope to moco_tpu/
         "R3": RuleScope(include=("moco_tpu/",),

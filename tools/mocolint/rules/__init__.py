@@ -10,4 +10,5 @@ from tools.mocolint.rules import (  # noqa: F401
     loaders,
     printing,
     threadsafety,
+    tracing,
 )

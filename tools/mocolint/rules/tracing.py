@@ -1,0 +1,79 @@
+"""R12: span discipline + trace.py's import diet (ISSUE 8 satellite).
+
+Two contracts, one rule:
+
+  1. Spans are opened ONLY through the context-manager API: every call
+     to `.span(...)` / `.begin_span(...)` must be the context expression
+     of a `with` statement. A span held outside `with` either never
+     records (no __enter__/__exit__) or — entered by hand without a
+     guaranteed exit — leaks on the opening thread's span stack and
+     corrupts parenting for everything after it. Retroactive recording
+     (`record_span`/`record_step`/`instant`) is the sanctioned escape
+     hatch for intervals that end on another thread.
+
+  2. `moco_tpu/telemetry/trace.py` imports NOTHING outside the standard
+     library — module-level or lazy. The out-of-process supervisor
+     imports it (and calls into it at runtime), and the supervisor's
+     contract is surviving exactly the failures that kill the jax/numpy
+     stack; one lazy `import jax` inside a method the supervisor calls
+     would couple their fates.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.mocolint.registry import Rule, register
+from tools.mocolint.rules.boundaries import _is_stdlib
+
+_OPENERS = ("span", "begin_span")
+_TRACE_MODULE_SUFFIX = "telemetry/trace.py"
+
+
+def _call_attr(node: ast.Call) -> str | None:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+@register
+class SpanDiscipline(Rule):
+    id = "R12"
+    title = "spans open via `with`; trace.py stays stdlib-only"
+    rationale = ("a span opened outside `with` never records or leaks on "
+                 "the thread span stack; a non-stdlib import in trace.py "
+                 "breaks the supervisor that must import it")
+    node_types = (ast.Call,)
+
+    def visit(self, node, ctx):
+        name = _call_attr(node)
+        if name not in _OPENERS:
+            return
+        if ctx.norm.endswith(_TRACE_MODULE_SUFFIX):
+            return  # the implementation itself constructs Span objects
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.withitem):
+            return
+        yield self.finding(
+            ctx, node.lineno,
+            f"`{name}(...)` outside a `with` statement — spans may only "
+            "be opened via the context-manager API (use `with "
+            f"tracer.{name}(...) as sp:`; for intervals that end "
+            "elsewhere, record retroactively with record_span)",
+        )
+
+    def check_file(self, ctx):
+        if not ctx.norm.endswith(_TRACE_MODULE_SUFFIX):
+            return
+        for edge in ctx.imports:
+            if edge.type_checking or _is_stdlib(edge.module):
+                continue
+            yield self.finding(
+                ctx, edge.line,
+                f"trace.py imports non-stdlib module {edge.module!r}"
+                f"{' (lazy)' if edge.lazy else ''} — it must stay "
+                "importable and callable without jax/numpy: the "
+                "out-of-process supervisor depends on it",
+            )

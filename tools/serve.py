@@ -55,11 +55,23 @@ def build_service(config: ServeConfig):
         buckets=config.buckets,
     )
     registry = None
+    tracer = None
     if config.telemetry_dir:
         from moco_tpu.telemetry.registry import EVENTS_FILENAME, MetricsRegistry
+        from moco_tpu.telemetry.trace import Tracer
 
+        # span layer (ISSUE 8): serve spans (request/flush/engine) +
+        # SIGUSR1 / trigger-file / shed-spike capture windows land in the
+        # same telemetry dir; the registry stamps the tracer's run_id so
+        # serve snapshots join the merged timeline
+        tracer = Tracer(
+            config.telemetry_dir, config.trace_mode, proc="serve",
+            capture_steps=config.trace_capture_steps,
+            capture_budget=config.trace_capture_budget,
+        )
         registry = MetricsRegistry(
-            os.path.join(config.telemetry_dir, EVENTS_FILENAME)
+            os.path.join(config.telemetry_dir, EVENTS_FILENAME),
+            stamp={"run_id": tracer.run_id, "trace_id": tracer.trace_id},
         )
     knn_bank = knn_labels = None
     if config.knn_bank:
@@ -78,6 +90,8 @@ def build_service(config: ServeConfig):
         cache_mb=config.embed_cache_mb,
         registry=registry,
         snapshot_every=config.snapshot_every,
+        tracer=tracer,
+        shed_spike_min=config.trace_shed_spike,
         knn_bank=knn_bank,
         knn_labels=knn_labels,
         num_classes=config.num_classes,
@@ -125,6 +139,8 @@ def main(argv=None) -> int:
 
     from moco_tpu.resilience.preemption import PreemptionHandler
 
+    if service.tracer is not None:
+        service.tracer.install_signal()  # SIGUSR1 arms a capture window
     with PreemptionHandler() as pre:
         frontend.start()
         info(
@@ -142,6 +158,8 @@ def main(argv=None) -> int:
     )
     service.drain(config.drain_timeout_s)
     frontend.shutdown()
+    if service.tracer is not None:
+        service.tracer.close()
     if registry is not None:
         registry.close()
     info("drained cleanly")

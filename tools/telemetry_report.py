@@ -3,6 +3,7 @@
 
     python tools/telemetry_report.py <run_dir>/telemetry/events.jsonl
     python tools/telemetry_report.py events.jsonl --json
+    python tools/telemetry_report.py events.jsonl --follow
 
 Renders, from the schema-versioned record stream the driver writes
 (moco_tpu/telemetry/registry.py):
@@ -26,6 +27,14 @@ Renders, from the schema-versioned record stream the driver writes
     (the LAST snapshot summarizes the run)
   - pod-record count and worst cross-host step-time spread
 
+`--follow` (ISSUE 8 satellite) is the live-tail mode: poll the file and
+render step/incident/supervisor/serve lines AS THEY LAND — the operator's
+view of a run in progress, reading the same stream every offline consumer
+reads. Reads are partial-line-safe (the writer flushes whole buffers, but
+a poll can still catch a line mid-write: bytes after the last newline
+stay buffered until the newline arrives), survive the file not existing
+yet (supervisor started before the child), and reset on truncation.
+
 Robustness: unparseable lines (a torn tail from a SIGKILL mid-flush) are
 counted and skipped, never fatal; unknown record kinds and unknown future
 schema versions are tallied but not interpreted. `--json` emits one
@@ -37,7 +46,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 
 
 def load_events(path: str) -> tuple[list[dict], int]:
@@ -112,7 +123,7 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
             k: first[k]
             for k in ("name", "variant", "arch", "batch_size", "n_chips",
                       "n_procs", "device_kind", "peak_flops_per_chip",
-                      "flops_per_step")
+                      "flops_per_step", "run_id", "trace_id")
             if k in first
         }
     if step_s:
@@ -402,12 +413,133 @@ def render(summary: dict) -> str:
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------------------------
+# live tail (--follow)
+# ---------------------------------------------------------------------------
+
+
+def render_record(rec: dict) -> str | None:
+    """One human line per record for the live tail; None for record kinds
+    with no line-by-line story (pod vectors ride the summary)."""
+    kind = rec.get("kind")
+    if kind == "step":
+        parts = [f"step {rec.get('step', '?'):>6}"]
+        if "step_s" in rec:
+            parts.append(f"{1e3 * rec['step_s']:8.1f} ms")
+        share = []
+        for phase in ("data_s", "host_s", "telemetry_s"):
+            if phase in rec and rec.get("step_s"):
+                share.append(
+                    f"{phase[:-2]} {100 * rec[phase] / rec['step_s']:.0f}%"
+                )
+        if share:
+            parts.append("(" + " · ".join(share) + ")")
+        if "imgs_per_sec" in rec:
+            parts.append(f"{rec['imgs_per_sec']:.1f} img/s")
+        if "loss" in rec:
+            parts.append(f"loss {rec['loss']:.4f}"
+                         if isinstance(rec["loss"], float)
+                         else f"loss {rec['loss']}")
+        return "  ".join(parts)
+    if kind == "event":
+        name = rec.get("event", "?")
+        detail = " ".join(
+            f"{k}={v}" for k, v in rec.items()
+            if k not in ("v", "t", "kind", "event", "msg", "run_id",
+                         "trace_id")
+        )
+        msg = rec.get("msg", "")
+        return f"[{name}] {msg}{' ' if msg and detail else ''}{detail}".rstrip()
+    if kind == "supervisor":
+        detail = " ".join(
+            f"{k}={v}" for k, v in rec.items()
+            if k not in ("v", "t", "kind", "event", "run_id", "trace_id")
+        )
+        return f"supervisor: {rec.get('event', '?')} {detail}".rstrip()
+    if kind == "serve":
+        lat = rec.get("latency_ms") or {}
+        return (
+            f"serve: {rec.get('served', 0)}/{rec.get('requests', 0)} served"
+            f" · p95 {lat.get('p95', 0):.1f} ms · queue "
+            f"{rec.get('queue_depth', 0)}"
+        )
+    if kind == "run_start":
+        return (f"run_start: {rec.get('name', '?')} arch="
+                f"{rec.get('arch', '?')} batch={rec.get('batch_size', '?')}"
+                f" run_id={rec.get('run_id', '-')}")
+    if kind == "run_end":
+        return (f"run_end: {rec.get('steps', 0)} steps, "
+                f"{rec.get('incidents', 0)} incident(s)")
+    return None
+
+
+def follow(path: str, out=None, poll_secs: float = 0.5, stop=None,
+           from_start: bool = True) -> int:
+    """Tail `path`, rendering records as complete lines land. Returns the
+    number of records rendered (useful for tests; the CLI runs until
+    interrupted). `stop` is an optional threading.Event-like object."""
+    out = out or sys.stdout
+    rendered = 0
+    offset = 0
+    buffer = b""
+    if not from_start:
+        try:
+            offset = os.path.getsize(path)
+        except OSError:
+            offset = 0
+    while stop is None or not stop.is_set():
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            time.sleep(poll_secs)  # not created yet (child still booting)
+            continue
+        if size < offset:  # truncated/rotated: start over
+            offset, buffer = 0, b""
+        if size > offset:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                chunk = f.read()
+            offset += len(chunk)
+            buffer += chunk
+            # partial-line safety: only lines TERMINATED by a newline are
+            # parsed; the unterminated tail waits for its next chunk
+            *complete, buffer = buffer.split(b"\n")
+            for raw in complete:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    rec = json.loads(raw.decode("utf-8", errors="replace"))
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                line = render_record(rec)
+                if line is not None:
+                    print(line, file=out, flush=True)
+                    rendered += 1
+        else:
+            time.sleep(poll_secs)
+    return rendered
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     parser.add_argument("events", help="path to telemetry events.jsonl")
     parser.add_argument("--json", action="store_true",
                         help="emit one machine-readable summary object")
+    parser.add_argument("--follow", action="store_true",
+                        help="live-tail: render step/incident/supervisor "
+                             "lines as they land (ctrl-C to stop)")
+    parser.add_argument("--poll-secs", type=float, default=0.5,
+                        help="--follow poll cadence")
     args = parser.parse_args(argv)
+    if args.follow:
+        try:
+            follow(args.events, poll_secs=args.poll_secs)
+        except KeyboardInterrupt:
+            pass
+        return 0
     try:
         records, skipped = load_events(args.events)
     except OSError as e:

@@ -1,0 +1,360 @@
+#!/usr/bin/env python
+"""Merge spans + events from supervisor, driver and serve into ONE
+Chrome-trace/Perfetto JSON timeline (ISSUE 8 tentpole part 2).
+
+    python tools/trace_report.py runs/r1/telemetry
+    python tools/trace_report.py runs/r1/telemetry runs/serve/telemetry \
+        -o timeline.json
+    python tools/trace_report.py runs/r1/telemetry --run <run_id> --json
+
+Inputs are telemetry DIRS (each contributing its `spans.jsonl` and
+`events.jsonl`) or explicit .jsonl files. Output:
+
+  - a Chrome-trace JSON (`{"traceEvents": [...]}`) at `-o` (default
+    `<first input dir>/trace.json`): one track per (process, thread) —
+    "X" complete events for spans, "i" instant events for incidents and
+    supervisor lifecycle records, "M" metadata naming each track from the
+    span's `proc`/`thread` labels. Open in Perfetto (ui.perfetto.dev) or
+    chrome://tracing.
+  - a per-step critical-path summary on stdout (or one `--json` object):
+    over the step spans, where the wall time went — data vs host vs
+    telemetry vs (fenced) device/comm — and which phase dominates.
+
+Everything joins on the `run_id` the supervisor minted and stamped down
+through env vars (telemetry/trace.py): spans carry it natively, events
+carry it since the registry stamp. `--run` filters to one run when a dir
+accumulated several. Pure stdlib — runs anywhere the files can be copied.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+SPANS_FILENAME = "spans.jsonl"
+EVENTS_FILENAME = "events.jsonl"
+
+# events.jsonl kinds rendered as instant events on the timeline; `step`
+# records are omitted (the step SPANS carry the same phases, with ids)
+_INSTANT_KINDS = ("event", "supervisor", "run_start", "run_end",
+                  "serve_start")
+
+
+def load_jsonl(path: str) -> tuple[list[dict], int]:
+    """Parse one JSONL file; (records, skipped_lines) — torn tails from a
+    SIGKILL mid-flush are counted, never fatal."""
+    records, skipped = [], 0
+    try:
+        f = open(path, encoding="utf-8")
+    except OSError:
+        return [], 0
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+            else:
+                skipped += 1
+    return records, skipped
+
+
+def collect(paths: list[str]) -> dict:
+    """Gather spans + events from every input dir/file."""
+    spans: list[dict] = []
+    events: list[dict] = []
+    skipped = 0
+    for path in paths:
+        if os.path.isdir(path):
+            candidates = [os.path.join(path, SPANS_FILENAME),
+                          os.path.join(path, EVENTS_FILENAME)]
+        else:
+            candidates = [path]
+        for cand in candidates:
+            records, bad = load_jsonl(cand)
+            skipped += bad
+            for rec in records:
+                (spans if rec.get("kind") == "span" else events).append(rec)
+    return {"spans": spans, "events": events, "skipped": skipped}
+
+
+def _run_of(rec: dict) -> str:
+    return str(rec.get("run") or rec.get("run_id") or "")
+
+
+def filter_run(data: dict, run_id: str | None) -> dict:
+    """Keep one run's records. Records with NO run id (events written by
+    processes that predate the stamp, e.g. an old stream) are kept — a
+    report must degrade, not discard evidence."""
+    if not run_id:
+        return data
+    keep = lambda r: _run_of(r) in (run_id, "")  # noqa: E731
+    return {
+        "spans": [s for s in data["spans"] if keep(s)],
+        "events": [e for e in data["events"] if keep(e)],
+        "skipped": data["skipped"],
+    }
+
+
+def run_ids(data: dict) -> list[str]:
+    seen: dict[str, None] = {}
+    for rec in data["spans"] + data["events"]:
+        rid = _run_of(rec)
+        if rid:
+            seen.setdefault(rid)
+    return list(seen)
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace assembly
+# ---------------------------------------------------------------------------
+
+
+def to_chrome_trace(data: dict) -> dict:
+    """`{"traceEvents": [...]}` — the one JSON both Perfetto and
+    chrome://tracing load. Timestamps are wall-clock µs: every process
+    stamped `time.time()`, so cross-process ordering is as honest as the
+    host clocks (one host in this repo's topology)."""
+    trace_events: list[dict] = []
+    procs: dict[int, str] = {}
+    threads: dict[tuple[int, int], str] = {}
+    for s in data["spans"]:
+        pid = int(s.get("pid", 0))
+        tid = int(s.get("tid") or 0)
+        procs.setdefault(pid, str(s.get("proc", f"pid {pid}")))
+        threads.setdefault((pid, tid), str(s.get("thread", f"tid {tid}")))
+        args = {
+            "run_id": s.get("run"),
+            "trace_id": s.get("trace"),
+            "span_id": s.get("span"),
+        }
+        if s.get("parent"):
+            args["parent_id"] = s["parent"]
+        args.update(s.get("attrs") or {})
+        dur_us = float(s.get("dur", 0.0)) * 1e6
+        event = {
+            "name": str(s.get("name", "?")),
+            "cat": str(s.get("cat", "span")),
+            "ts": float(s.get("t", 0.0)) * 1e6,
+            "pid": pid,
+            "tid": tid,
+            "args": args,
+        }
+        if dur_us > 0:
+            event["ph"] = "X"
+            event["dur"] = dur_us
+        else:  # zero-duration span (capture markers): an instant
+            event["ph"] = "i"
+            event["s"] = "t"
+        trace_events.append(event)
+    # events.jsonl incidents as process-scoped instants; the record's own
+    # pid when it names one (supervisor records name the CHILD pid — keep
+    # the supervisor's own records on a synthetic track per source kind)
+    for e in data["events"]:
+        kind = e.get("kind")
+        if kind not in _INSTANT_KINDS:
+            continue
+        name = str(e.get("event", kind))
+        pid = int(e.get("pid", 0)) if kind != "supervisor" else 0
+        procs.setdefault(pid, "events" if pid == 0 else f"pid {pid}")
+        threads.setdefault((pid, 0), str(kind))
+        args = {k: v for k, v in e.items()
+                if k not in ("v", "t", "kind") and _plain(v)}
+        trace_events.append({
+            "name": name,
+            "cat": str(kind),
+            "ph": "i",
+            "s": "p",
+            "ts": float(e.get("t", 0.0)) * 1e6,
+            "pid": pid,
+            "tid": 0,
+            "args": args,
+        })
+    for pid, label in procs.items():
+        trace_events.append({"ph": "M", "name": "process_name", "pid": pid,
+                             "args": {"name": label}})
+    for (pid, tid), label in threads.items():
+        trace_events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                             "tid": tid, "args": {"name": label}})
+    trace_events.sort(key=lambda ev: ev.get("ts", 0.0))
+    return {"traceEvents": trace_events,
+            "displayTimeUnit": "ms"}
+
+
+def _plain(value) -> bool:
+    return isinstance(value, (str, int, float, bool, type(None)))
+
+
+# ---------------------------------------------------------------------------
+# per-step critical-path summary
+# ---------------------------------------------------------------------------
+
+_PHASES = ("data_s", "host_s", "telemetry_s", "device_s", "comm_s")
+
+
+def _percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(0, min(len(ordered) - 1, round(q / 100.0 * (len(ordered) - 1))))
+    return ordered[int(rank)]
+
+
+def summarize(data: dict) -> dict:
+    """Fold the merged records into the --json summary object."""
+    spans = data["spans"]
+    by_proc: dict[str, int] = {}
+    for s in spans:
+        key = str(s.get("proc", "?"))
+        by_proc[key] = by_proc.get(key, 0) + 1
+    step_spans = [s for s in spans if s.get("cat") == "step"]
+    captures = [s for s in spans if s.get("cat") == "capture"]
+    summary: dict = {
+        "spans": len(spans),
+        "spans_by_proc": by_proc,
+        "events": len(data["events"]),
+        "skipped_lines": data["skipped"],
+        "run_ids": run_ids(data),
+        "steps": len(step_spans),
+    }
+    if step_spans:
+        attrs = [s.get("attrs") or {} for s in step_spans]
+        step_s = [float(a.get("step_s", s.get("dur", 0.0)))
+                  for a, s in zip(attrs, step_spans)]
+        total = sum(step_s) or 1.0
+        shares = {}
+        for phase in _PHASES:
+            vals = [float(a[phase]) for a in attrs if phase in a]
+            if vals:
+                shares[phase[:-2]] = round(sum(vals) / total, 4)
+        # the phases are measured differently (data/host/telemetry are
+        # wall segments of every step; device/comm are fenced drain
+        # samples) — the dominant WALL segment is the critical path the
+        # next perf PR should attack, with the fenced numbers as context
+        wall = {k: v for k, v in shares.items()
+                if k in ("data", "host", "telemetry")}
+        summary["step_time_ms"] = {
+            "p50": round(_percentile(step_s, 50) * 1e3, 3),
+            "p95": round(_percentile(step_s, 95) * 1e3, 3),
+            "p99": round(_percentile(step_s, 99) * 1e3, 3),
+        }
+        summary["phase_share"] = shares
+        if wall:
+            dominant = max(wall, key=wall.get)
+            rest = 1.0 - sum(wall.values())
+            summary["critical_path"] = (
+                dominant if wall[dominant] >= rest else "async-device/other"
+            )
+    if captures:
+        summary["captures"] = [
+            dict({"name": s.get("name")}, **(s.get("attrs") or {}))
+            for s in captures
+        ]
+    anomalies = [e for e in data["events"]
+                 if e.get("event") == "trace_anomaly"]
+    if anomalies:
+        summary["anomalies"] = [
+            {k: v for k, v in e.items() if k not in ("v", "kind")}
+            for e in anomalies
+        ]
+    return summary
+
+
+def render(summary: dict) -> str:
+    lines = [
+        f"merged {summary['spans']} span(s) from "
+        + ", ".join(f"{proc}×{n}"
+                    for proc, n in sorted(summary["spans_by_proc"].items()))
+        + f" · {summary['events']} event record(s) · "
+        f"{summary['skipped_lines']} unparseable line(s) skipped"
+    ]
+    rids = summary.get("run_ids", [])
+    if len(rids) == 1:
+        lines.append(f"run: {rids[0]}")
+    elif rids:
+        lines.append(f"runs: {', '.join(rids)} (use --run to isolate one)")
+    pct = summary.get("step_time_ms")
+    if pct:
+        lines.append(
+            f"steps: {summary['steps']} · p50 {pct['p50']:.1f} ms · "
+            f"p95 {pct['p95']:.1f} ms · p99 {pct['p99']:.1f} ms"
+        )
+        share = summary.get("phase_share", {})
+        parts = " · ".join(
+            f"{name} {100 * share[name]:.1f}%"
+            for name in ("data", "host", "telemetry") if name in share
+        )
+        if parts:
+            lines.append(f"  wall share: {parts} (rest: async device/meters)")
+        fenced = " · ".join(
+            f"{name} {100 * share[name]:.1f}%"
+            for name in ("device", "comm") if name in share
+        )
+        if fenced:
+            lines.append(f"  fenced drain share: {fenced}")
+        if "critical_path" in summary:
+            lines.append(f"  critical path: {summary['critical_path']}")
+    for cap in summary.get("captures", []):
+        if cap.get("name") == "capture_start":
+            lines.append(
+                f"capture: {cap.get('reason', '?')} at step "
+                f"{cap.get('step', '?')} "
+                f"({cap.get('captures_used', '?')} used)"
+            )
+    for a in summary.get("anomalies", []):
+        lines.append(
+            f"anomaly: {a.get('anomaly', '?')} at step {a.get('step', '?')}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    parser.add_argument("paths", nargs="+",
+                        help="telemetry dir(s) and/or explicit .jsonl files")
+    parser.add_argument("-o", "--output", default="",
+                        help="Chrome-trace JSON output path (default "
+                             "<first input dir>/trace.json; '-' writes the "
+                             "JSON to stdout instead of the summary)")
+    parser.add_argument("--run", default="",
+                        help="keep only this run_id")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the machine-readable summary object")
+    args = parser.parse_args(argv)
+    data = filter_run(collect(args.paths), args.run or None)
+    if not data["spans"] and not data["events"]:
+        print("no spans or events found (trace_mode=off and nothing "
+              "captured?)", file=sys.stderr)
+        return 1
+    chrome = to_chrome_trace(data)
+    out = args.output
+    if out == "-":
+        json.dump(chrome, sys.stdout)
+        return 0
+    if not out:
+        first_dir = (args.paths[0] if os.path.isdir(args.paths[0])
+                     else os.path.dirname(args.paths[0]) or ".")
+        out = os.path.join(first_dir, "trace.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(chrome, f)
+    summary = summarize(data)
+    summary["chrome_trace"] = out
+    if args.json:
+        print(json.dumps(summary, default=float))
+    else:
+        print(render(summary))
+        print(f"chrome trace: {out} (open in ui.perfetto.dev or "
+              "chrome://tracing)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
