@@ -22,6 +22,22 @@ from moco_tpu.evals.lincls import _val_split, load_frozen_backbone
 from moco_tpu.ops.knn import knn_accuracy
 
 
+def build_feature_fn(model):
+    """The frozen-encoder eval program: eval-mode forward + L2 norm, jitted
+    once and reused across batches (the during-training kNN monitor passes
+    it back in). Module-level so tools/progcheck can audit the SAME
+    program the evals run (ISSUE 9)."""
+
+    @jax.jit
+    def feature_fn(params, stats, images):
+        out = model.apply(
+            {"params": params, "batch_stats": stats}, images, train=False
+        )
+        return out / jnp.linalg.norm(out, axis=-1, keepdims=True)
+
+    return feature_fn
+
+
 def encode_dataset(
     model,
     params,
@@ -46,13 +62,7 @@ def encode_dataset(
     key = jax.random.key(0)
 
     if feature_fn is None:
-
-        @jax.jit
-        def feature_fn(params, stats, images):
-            out = model.apply(
-                {"params": params, "batch_stats": stats}, images, train=False
-            )
-            return out / jnp.linalg.norm(out, axis=-1, keepdims=True)
+        feature_fn = build_feature_fn(model)
 
     sharding = None
     if mesh is not None and mesh.size > 1:

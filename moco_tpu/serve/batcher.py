@@ -107,6 +107,9 @@ class PendingRequest:
     def __init__(self, payload, enqueue_t: float, deadline_t: float):
         self.payload = payload
         self.enqueue_t = enqueue_t
+        # wall-clock by design: retroactive request spans must merge
+        # with other processes' timelines on a shared clock; the value
+        # never feeds computation  # mocolint: disable=R9
         self.enqueue_wall = time.time()
         self.deadline_t = deadline_t
         self.result = None

@@ -67,7 +67,7 @@ class EmbedService:
         self._lock = threading.Lock()
         self.requests = 0
         self.served = 0
-        self._started = time.time()
+        self._started = time.monotonic()  # uptime is a duration, not a timestamp
         self._h_latency = Histogram("serve_latency_s", window=STATS_WINDOW)
         self._h_queue_wait = Histogram("serve_queue_wait_s",
                                        window=STATS_WINDOW)
@@ -210,7 +210,7 @@ class EmbedService:
             "latency_ms": self._h_latency.percentiles_ms(),
             "queue_wait_ms": self._h_queue_wait.percentiles_ms(),
             "draining": self.draining,
-            "uptime_s": round(time.time() - self._started, 1),
+            "uptime_s": round(time.monotonic() - self._started, 1),
         }
         if self.cache is not None:
             out["cache"] = {

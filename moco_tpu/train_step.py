@@ -178,6 +178,100 @@ def build_fused_step(step_fn, two_crops_fn, data_key):
     return fused_step
 
 
+def _build_key_path(config: PretrainConfig, model):
+    """The region's key-encoder branch as ONE shared function: ShuffleBN
+    shuffle → key forward (per-device BN stats) → unshuffle → L2-norm →
+    `stop_gradient` (the reference's no_grad key path, `moco/builder.py`).
+
+    Shared by the spmd_region AND `build_grad_probe` so the audited program
+    (progcheck P1: no differentiable path from the loss into the key
+    encoder) is the SAME code the train step traces — deleting the
+    stop_gradient here changes both, and the auditor fires."""
+
+    def key_path(params_k, stats_k, im_k, key):
+        if config.shuffle_mode == "ring":
+            from moco_tpu.parallel.collectives import ring_shuffle
+
+            im_k_shuf = ring_shuffle(im_k, DATA_AXIS)
+        else:
+            im_k_shuf, perm = batch_shuffle(im_k, key, DATA_AXIS)
+        k, mut_k = model.apply(
+            {"params": params_k, "batch_stats": stats_k},
+            im_k_shuf,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        k = l2_normalize(k)
+        if config.shuffle_mode == "ring":
+            k = ring_shuffle(k, DATA_AXIS, inverse=True)
+        else:
+            k = batch_unshuffle(k, perm, DATA_AXIS)
+        k = lax.stop_gradient(k)  # the reference's no_grad key path
+        return k, mut_k["batch_stats"]
+
+    return key_path
+
+
+def _build_query_loss(config: PretrainConfig, model, temperature: float):
+    """The region's differentiable core: query forward → InfoNCE against
+    (keys, queue). Shared by the spmd_region's value_and_grad and the
+    grad-flow probe (which also differentiates w.r.t. the queue)."""
+
+    def query_loss(pq, stats_q, im_q, k, queue):
+        q, mut_q = model.apply(
+            {"params": pq, "batch_stats": stats_q},
+            im_q,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        q = l2_normalize(q)
+        logits, labels = infonce_logits(q, k, queue, temperature)
+        return softmax_cross_entropy(logits, labels), (
+            mut_q["batch_stats"],
+            logits,
+            labels,
+        )
+
+    return query_loss
+
+
+def build_grad_probe(config: PretrainConfig, model, mesh):
+    """The differentiable audit surface (ISSUE 9, tools/progcheck P1).
+
+    Returns a shard_map'd `(params_q, params_k, stats_q, stats_k, queue,
+    im_q, im_k, key) -> (g_q, g_k, g_queue)` that differentiates the SAME
+    key-path + InfoNCE code the train step traces — w.r.t. the query params
+    AND the key params AND the queue. The MoCo contract (He et al.) is that
+    the key branch ends in stop_gradient, so `g_k`/`g_queue` must be
+    STRUCTURALLY zero: progcheck proves from the jaxpr that those outputs
+    depend on no program input, instead of sampling finite differences.
+    Grads route through the fused GradSync reduce (lint R7: grads meet
+    collectives only via the gradsync API)."""
+    from moco_tpu.parallel.gradsync import GradSync
+
+    temperature = config.temperature
+    key_path = _build_key_path(config, model)
+    query_loss = _build_query_loss(config, model, temperature)
+    gradsync = GradSync(config.replace(grad_sync="fused"), mesh.size)
+
+    def probe(params_q, params_k, stats_q, stats_k, queue, im_q, im_k, key):
+        def loss_of(pq, pk, qu):
+            k, _ = key_path(pk, stats_k, im_k, key)
+            loss, _aux = query_loss(pq, stats_q, im_q, k, qu)
+            return loss
+
+        grads = jax.grad(loss_of, argnums=(0, 1, 2))(params_q, params_k, queue)
+        reduced, _, _probe = gradsync.region_reduce(grads, {}, jnp.int32(0))
+        return reduced
+
+    return shard_map(
+        probe,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=P(),
+    )
+
+
 def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: int, sched=None):
     """Return jitted `(state, im_q, im_k) -> (state', metrics)`, state donated.
 
@@ -206,46 +300,20 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
 
     gradsync = GradSync(config, mesh.size)
 
+    # --- ShuffleBN key path + InfoNCE core, factored so build_grad_probe
+    # audits exactly this code (ISSUE 9): "permute" = the reference-faithful
+    # all-gather + shared-RNG global permutation; "ring" = half-shard roll
+    # (2 ppermutes, partial decorrelation — see collectives.ring_shuffle for
+    # why whole-shard rotation would be a no-op)
+    key_path = _build_key_path(config, model)
+    query_loss = _build_query_loss(config, model, temperature)
+
     def spmd_region(params_q, params_k, stats_q, stats_k, queue, gs_state,
                     im_q, im_k, key, step):
-        # --- ShuffleBN: decorrelate per-device BN groups on the key path ---
-        # "permute" = the reference-faithful all-gather + shared-RNG global
-        # permutation; "ring" = half-shard roll (2 ppermutes, partial
-        # decorrelation — see collectives.ring_shuffle for why whole-shard
-        # rotation would be a no-op)
-        if config.shuffle_mode == "ring":
-            from moco_tpu.parallel.collectives import ring_shuffle
-
-            im_k_shuf = ring_shuffle(im_k, DATA_AXIS)
-        else:
-            im_k_shuf, perm = batch_shuffle(im_k, key, DATA_AXIS)
-        k, mut_k = model.apply(
-            {"params": params_k, "batch_stats": stats_k},
-            im_k_shuf,
-            train=True,
-            mutable=["batch_stats"],
-        )
-        k = l2_normalize(k)
-        if config.shuffle_mode == "ring":
-            k = ring_shuffle(k, DATA_AXIS, inverse=True)
-        else:
-            k = batch_unshuffle(k, perm, DATA_AXIS)
-        k = lax.stop_gradient(k)  # the reference's no_grad key path
+        k, new_stats_k_local = key_path(params_k, stats_k, im_k, key)
 
         def loss_fn(pq):
-            q, mut_q = model.apply(
-                {"params": pq, "batch_stats": stats_q},
-                im_q,
-                train=True,
-                mutable=["batch_stats"],
-            )
-            q = l2_normalize(q)
-            logits, labels = infonce_logits(q, k, queue, temperature)
-            return softmax_cross_entropy(logits, labels), (
-                mut_q["batch_stats"],
-                logits,
-                labels,
-            )
+            return query_loss(pq, stats_q, im_q, k, queue)
 
         (loss, (new_stats_q, logits, labels)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
@@ -256,7 +324,7 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
         # Running BN stats: averaged across devices so replicas stay
         # bit-identical (replaces DDP broadcast_buffers, SURVEY §2.2 note).
         new_stats_q = lax.pmean(new_stats_q, DATA_AXIS)
-        new_stats_k = lax.pmean(mut_k["batch_stats"], DATA_AXIS)
+        new_stats_k = lax.pmean(new_stats_k_local, DATA_AXIS)
         acc1, acc5 = contrastive_accuracy(logits, labels)
         # positive-pair cosine alignment (column 0 is q·k⁺/T): the cheapest
         # honest learning signal — only aug-invariance optimization moves

@@ -101,6 +101,87 @@ def create_v3_train_state(
     )
 
 
+def _build_apply(model: V3Model):
+    def apply(params, stats, x, predict):
+        out, mut = model.apply(
+            {"params": params, "batch_stats": stats},
+            x,
+            train=True,
+            predict=predict,
+            mutable=["batch_stats"],
+        )
+        return l2_normalize(out), mut["batch_stats"]
+
+    return apply
+
+
+def _build_momentum_keys(model: V3Model):
+    """The momentum-encoder branch, shared by the spmd_region and
+    `build_v3_grad_probe` (ISSUE 9): keys for both crops (running stats
+    chained through the two forwards, as two sequential reference forward
+    calls would), stop-gradded — the v3 contract that no gradient reaches
+    the momentum encoder."""
+    apply = _build_apply(model)
+
+    def momentum_keys(params_k, stats_k, x1, x2):
+        k1, stats_k = apply(params_k, stats_k, x1, predict=False)
+        k2, stats_k = apply(params_k, stats_k, x2, predict=False)
+        k1 = lax.stop_gradient(k1)
+        k2 = lax.stop_gradient(k2)
+        return k1, k2, stats_k
+
+    return momentum_keys
+
+
+def _build_query_loss(model: V3Model, temperature: float):
+    """The symmetric v3 contrastive core, shared by the spmd_region's
+    value_and_grad and the grad-flow probe."""
+    apply = _build_apply(model)
+
+    def query_loss(pq, stats_q, x1, x2, k1, k2):
+        q1, s = apply(pq, stats_q, x1, predict=True)
+        q2, s = apply(pq, s, x2, predict=True)
+        loss = v3_contrastive_loss(q1, k2, temperature, DATA_AXIS) + \
+               v3_contrastive_loss(q2, k1, temperature, DATA_AXIS)
+        return loss, (s, q1)
+
+    return query_loss
+
+
+def build_v3_grad_probe(config: PretrainConfig, model: V3Model, mesh):
+    """The v3 differentiable audit surface (ISSUE 9, tools/progcheck P1):
+    shard_map'd `(params_q, params_k, stats_q, stats_k, x1, x2) ->
+    (g_q, g_k)` differentiating the SAME momentum-key + symmetric-loss code
+    the v3 step traces, w.r.t. the query AND momentum params. The momentum
+    branch ends in stop_gradient, so `g_k` must be structurally zero —
+    progcheck proves it from the jaxpr. Grads route through the fused
+    GradSync reduce (lint R7)."""
+    from jax.sharding import PartitionSpec as P
+
+    from moco_tpu.parallel.gradsync import GradSync
+
+    momentum_keys = _build_momentum_keys(model)
+    query_loss = _build_query_loss(model, config.temperature)
+    gradsync = GradSync(config.replace(grad_sync="fused"), mesh.size)
+
+    def probe(params_q, params_k, stats_q, stats_k, x1, x2):
+        def loss_of(pq, pk):
+            k1, k2, _ = momentum_keys(pk, stats_k, x1, x2)
+            loss, _aux = query_loss(pq, stats_q, x1, x2, k1, k2)
+            return loss
+
+        grads = jax.grad(loss_of, argnums=(0, 1))(params_q, params_k)
+        reduced, _, _probe = gradsync.region_reduce(grads, {}, jnp.int32(0))
+        return reduced
+
+    return shard_map(
+        probe,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    )
+
+
 def build_v3_train_step(
     config: PretrainConfig, model: V3Model, tx, mesh, steps_per_epoch: int, sched=None
 ):
@@ -113,32 +194,15 @@ def build_v3_train_step(
     if sched is None:
         sched = lr_schedule(config, steps_per_epoch)
     gradsync = GradSync(config, mesh.size)
-
-    def apply(params, stats, x, predict):
-        out, mut = model.apply(
-            {"params": params, "batch_stats": stats},
-            x,
-            train=True,
-            predict=predict,
-            mutable=["batch_stats"],
-        )
-        return l2_normalize(out), mut["batch_stats"]
+    momentum_keys = _build_momentum_keys(model)
+    query_loss = _build_query_loss(model, temperature)
 
     def spmd_region(params_q, params_k, stats_q, stats_k, gs_state, x1, x2,
                     step):
-        # momentum-encoder keys for both crops (running stats chained through
-        # the two forwards, as two sequential reference forward calls would)
-        k1, stats_k = apply(params_k, stats_k, x1, predict=False)
-        k2, stats_k = apply(params_k, stats_k, x2, predict=False)
-        k1 = lax.stop_gradient(k1)
-        k2 = lax.stop_gradient(k2)
+        k1, k2, stats_k = momentum_keys(params_k, stats_k, x1, x2)
 
         def loss_fn(pq):
-            q1, s = apply(pq, stats_q, x1, predict=True)
-            q2, s = apply(pq, s, x2, predict=True)
-            loss = v3_contrastive_loss(q1, k2, temperature, DATA_AXIS) + \
-                   v3_contrastive_loss(q2, k1, temperature, DATA_AXIS)
-            return loss, (s, q1)
+            return query_loss(pq, stats_q, x1, x2, k1, k2)
 
         (loss, (new_stats_q, q1)), grads = jax.value_and_grad(
             loss_fn, has_aux=True

@@ -530,3 +530,114 @@ def test_repo_gate_zero_unsuppressed_findings(extra):
     # generous CI headroom over the observed ~1.2 s; the contract is
     # "one parse per file", not a loaded-runner microbenchmark
     assert elapsed < 20.0, f"mocolint took {elapsed:.1f}s"
+
+
+# -- incremental cache (ISSUE 9 satellite) ----------------------------------
+
+
+R1_BODY = "try:\n    x = 1\nexcept:\n    pass\n"
+
+
+def test_cache_warm_run_replays_findings_without_parsing(tmp_path):
+    cache = str(tmp_path / "cache")
+    a = _write(tmp_path, "tree/a.py", R1_BODY)
+    _write(tmp_path, "tree/b.py", "x = 1\n")
+    eng = Engine(DEFAULT_CONFIG)
+    cold = eng.run([str(tmp_path / "tree")], cache_dir=cache)
+    assert cold.files_cached == 0 and cold.files_scanned == 2
+    warm = Engine(DEFAULT_CONFIG).run([str(tmp_path / "tree")],
+                                      cache_dir=cache)
+    assert warm.files_cached == 2
+    assert [(f.path, f.line, f.rule, f.message) for f in warm.findings] == \
+           [(f.path, f.line, f.rule, f.message) for f in cold.findings]
+    assert any(f.rule == "R1" and f.path == a for f in warm.findings)
+
+
+def test_cache_invalidates_only_the_edited_file(tmp_path):
+    cache = str(tmp_path / "cache")
+    _write(tmp_path, "tree/a.py", R1_BODY)
+    b = _write(tmp_path, "tree/b.py", "x = 1\n")
+    Engine(DEFAULT_CONFIG).run([str(tmp_path / "tree")], cache_dir=cache)
+    _write(tmp_path, "tree/b.py", R1_BODY)  # b now violates R1 too
+    warm = Engine(DEFAULT_CONFIG).run([str(tmp_path / "tree")],
+                                      cache_dir=cache)
+    assert warm.files_cached == 1  # a served from cache, b re-parsed
+    assert sum(1 for f in warm.findings if f.rule == "R1") == 2
+    assert any(f.path == b and f.rule == "R1" for f in warm.findings)
+
+
+def test_cache_cross_file_chains_recompute_on_warm_runs(tmp_path):
+    """The R11 transitive boundary walk must see a NEW violation in an
+    UNCHANGED file: serve/a.py (cached) imports helper.py; when helper
+    grows a module-level optax import, the chain finding lands in a.py
+    on the warm run — proof that finalize() is never served from cache."""
+    cache = str(tmp_path / "cache")
+    a = _write(tmp_path, "moco_tpu/serve/a.py", "import moco_tpu.helper\n")
+    _write(tmp_path, "moco_tpu/helper.py", "import os\n")
+    cold = Engine(DEFAULT_CONFIG).run([str(tmp_path / "moco_tpu")],
+                                      cache_dir=cache)
+    assert not any(f.rule == "R11" for f in cold.findings)
+    _write(tmp_path, "moco_tpu/helper.py", "import optax\n")
+    warm = Engine(DEFAULT_CONFIG).run([str(tmp_path / "moco_tpu")],
+                                      cache_dir=cache)
+    assert warm.files_cached == 1  # a.py unchanged, helper re-parsed
+    chains = [f for f in warm.findings if f.rule == "R11" and f.path == a]
+    assert chains and "optax" in chains[0].message
+
+
+def test_cache_keyed_on_rule_selection(tmp_path):
+    """A --select subset must not poison the full-run cache: the engine
+    fingerprint folds in the active rule set."""
+    cache = str(tmp_path / "cache")
+    _write(tmp_path, "tree/a.py", R1_BODY)
+    r = Engine(DEFAULT_CONFIG, select=("R9",)).run(
+        [str(tmp_path / "tree")], cache_dir=cache)
+    assert r.files_cached == 0
+    full = Engine(DEFAULT_CONFIG).run([str(tmp_path / "tree")],
+                                      cache_dir=cache)
+    assert full.files_cached == 0  # different fingerprint: cache miss
+    assert any(f.rule == "R1" for f in full.findings)
+
+
+def test_cache_cold_warm_timing(tmp_path):
+    """The satellite's pin: the warm path must stay cheaper than the
+    cold parse+walk as the tree grows (here: 60 files of real-ish code,
+    warm run serves all of them from cache and beats the cold run)."""
+    cache = str(tmp_path / "cache")
+    body = "import os\n" + "\n".join(
+        f"def f{i}(x):\n"
+        f"    y = x + {i}\n"
+        f"    for j in range(10):\n"
+        f"        y += j * {i}\n"
+        f"    return y\n"
+        for i in range(40)
+    )
+    for n in range(60):
+        _write(tmp_path, f"tree/m{n:02d}.py", body)
+    t0 = time.monotonic()
+    cold = Engine(DEFAULT_CONFIG).run([str(tmp_path / "tree")],
+                                      cache_dir=cache)
+    cold_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    warm = Engine(DEFAULT_CONFIG).run([str(tmp_path / "tree")],
+                                      cache_dir=cache)
+    warm_s = time.monotonic() - t0
+    assert cold.files_cached == 0 and warm.files_cached == 60
+    assert warm_s < cold_s, (
+        f"warm {warm_s:.3f}s not faster than cold {cold_s:.3f}s"
+    )
+
+
+def test_repo_gate_warm_cache(tmp_path):
+    """The tier-1 gate with the cache: cold run populates, warm run
+    serves every file and stays clean — the 'gate stays ~1 s as the tree
+    grows' contract."""
+    cache = str(tmp_path / "cache")
+    cold = _cli(["--cache", cache, "moco_tpu", "tools", "bench.py"])
+    assert cold.returncode == 0, cold.stdout + cold.stderr
+    t0 = time.monotonic()
+    warm = _cli(["--cache", cache, "moco_tpu", "tools", "bench.py"])
+    elapsed = time.monotonic() - t0
+    assert warm.returncode == 0, warm.stdout + warm.stderr
+    assert "cached" in warm.stdout
+    assert elapsed < 10.0, f"warm gate took {elapsed:.1f}s"

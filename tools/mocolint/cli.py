@@ -44,6 +44,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--write-baseline", default=None)
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids")
+    parser.add_argument("--cache", default=None, metavar="DIR",
+                        help="incremental per-file result cache dir "
+                             "(content-hash keyed; unchanged files skip "
+                             "parse+walk)")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -78,7 +82,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     try:
-        result = engine.run(paths, baseline_path=args.baseline)
+        result = engine.run(paths, baseline_path=args.baseline,
+                            cache_dir=args.cache)
     except (OSError, ValueError) as e:
         print(f"mocolint: {e}", file=sys.stderr)
         return 2
@@ -101,6 +106,8 @@ def main(argv: list[str] | None = None) -> int:
         tail.append(f"{len(result.suppressed)} suppressed")
     if result.baselined:
         tail.append(f"{len(result.baselined)} baselined")
+    if result.files_cached:
+        tail.append(f"{result.files_cached} cached")
     suffix = f" ({', '.join(tail)})" if tail else ""
     if result.findings:
         print(f"{len(result.findings)} finding(s) in "

@@ -139,7 +139,12 @@ LEGACY_CONFIG = LintConfig(
 
 # Modules whose values are covered by a bit-identity contract (resume /
 # staging / serve parity) — R9's scope. Python-side nondeterminism here
-# breaks guarantees tests elsewhere pin.
+# breaks guarantees tests elsewhere pin. ISSUE 9 satellite: the scope
+# covers the whole serve ENGINE SIDE (engine + content-hash cache +
+# batcher + service), not just engine.py — batch composition and cache
+# keys decide which program pads which rows, and the served-embedding
+# bit-identity test only holds if none of it consults a global RNG or a
+# wall clock. (parallel/ already covers gradsync.py.)
 BIT_IDENTITY_MODULES = (
     "moco_tpu/train_step.py",
     "moco_tpu/v3_step.py",
@@ -148,6 +153,9 @@ BIT_IDENTITY_MODULES = (
     "moco_tpu/data/canvas_cache.py",
     "moco_tpu/data/datasets.py",
     "moco_tpu/serve/engine.py",
+    "moco_tpu/serve/cache.py",
+    "moco_tpu/serve/batcher.py",
+    "moco_tpu/serve/service.py",
     "moco_tpu/ops/",
     "moco_tpu/parallel/",
 )

@@ -147,6 +147,7 @@ class Result:
     suppressed: list        # dropped by inline suppressions
     baselined: list         # dropped by the baseline file
     files_scanned: int
+    files_cached: int = 0   # served from the incremental cache (no parse)
 
     @property
     def clean(self) -> bool:
@@ -195,9 +196,24 @@ class Engine:
         # reporting must not flag suppressions of rules that never ran
         self._subset = select is not None
 
-    def run(self, paths, baseline_path: str | None = None) -> Result:
-        contexts: list[FileContext] = []
+    def run(self, paths, baseline_path: str | None = None,
+            cache_dir: str | None = None) -> Result:
+        """`cache_dir` enables the incremental per-file cache (ISSUE 9
+        satellite; tools/mocolint/cache.py): unchanged files skip parse +
+        walk and replay their cached per-file findings; cross-file
+        analysis (finalize) always re-runs over the full context set."""
+        cache = None
+        engine_fp = ""
+        if cache_dir:
+            from tools.mocolint import cache as cache_mod
+
+            cache = cache_mod.ResultCache(cache_dir)
+            engine_fp = cache_mod.engine_fingerprint(
+                self.config, [r.id for r in self.rules]
+            )
+        contexts: list = []          # FileContext | cache.SlimContext
         findings: list[Finding] = []
+        files_cached = 0
         for path in collect_files(paths):
             try:
                 with open(path, encoding="utf-8") as f:
@@ -206,6 +222,15 @@ class Engine:
                 findings.append(Finding(path, 0, "PARSE",
                                         f"unreadable ({e})"))
                 continue
+            if cache is not None:
+                content_hash = cache.content_hash(source)
+                hit = cache.load(path, norm(path), content_hash, engine_fp)
+                if hit is not None:
+                    ctx, cached_findings = hit
+                    contexts.append(ctx)
+                    findings.extend(cached_findings)
+                    files_cached += 1
+                    continue
             try:
                 tree = ast.parse(source, filename=path)
             except SyntaxError as e:
@@ -214,7 +239,10 @@ class Engine:
                 continue
             ctx = FileContext(path, source, tree)
             contexts.append(ctx)
-            findings.extend(self._check_file(ctx))
+            file_findings = list(self._check_file(ctx))
+            findings.extend(file_findings)
+            if cache is not None:
+                cache.store(ctx, file_findings, content_hash, engine_fp)
         project = Project(contexts)
         for rule in self.rules:
             findings.extend(rule.finalize(project))
@@ -255,6 +283,7 @@ class Engine:
             suppressed=suppressed,
             baselined=baselined,
             files_scanned=len(contexts),
+            files_cached=files_cached,
         )
 
     def _check_file(self, ctx: FileContext):

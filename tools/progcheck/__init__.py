@@ -1,0 +1,21 @@
+"""progcheck: jaxpr-level program auditor (ISSUE 9 tentpole).
+
+mocolint (tools/mocolint) guards SOURCE-level contracts; the invariants
+that actually define MoCo correctness live in the traced program, where
+the AST cannot see them: no gradient flows into the key encoder (He et
+al.), the queue/EMA updates are non-differentiable, the configured
+gradient sync moves exactly the payload its telemetry claims, step
+programs host no callbacks, donated state really aliases.
+
+progcheck enumerates the repo's full compiled-program surface (train/v3
+steps under every grad_sync mode, the serve bucket ladder, h2d_trim
+shape variants, eval programs — tools/progcheck/surface.py) via abstract
+tracing (`jax.make_jaxpr` over `eval_shape`-built states: no weights are
+initialized, no program runs), then runs pluggable semantic checks over
+every jaxpr (tools/progcheck/checks/). The per-program inventory (shape
+signature, `cost_analysis` FLOPs, collective payload bytes) doubles as
+the seed data for the planned CompiledRegistry (ROADMAP item 5).
+
+Structured like mocolint on purpose: check registry with metadata,
+`--list-checks`, `--select`, committed baseline, `--json`, exit 0/1/2.
+"""

@@ -1,0 +1,11 @@
+"""Built-in progcheck checks. Importing this package registers them."""
+
+from tools.progcheck.checks import (  # noqa: F401
+    callbacks,
+    collective_axes,
+    compile_set,
+    donation,
+    dtype_policy,
+    gradflow,
+    wire_bytes,
+)

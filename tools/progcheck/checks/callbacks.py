@@ -1,0 +1,39 @@
+"""P6: step programs host no Python callbacks.
+
+A `debug_print`/`pure_callback`/`io_callback` inside a step program
+drags a host round-trip onto the device critical path EVERY step — the
+async dispatch pipeline the whole input-overlap design depends on stalls
+behind it (mocolint R8 guards the source-level cousins; this sees the
+traced truth, including callbacks smuggled in through a library call).
+"""
+
+from __future__ import annotations
+
+from tools.progcheck.jaxpr_utils import CALLBACK_PRIMS, walk_eqns
+from tools.progcheck.registry import Check, register
+
+
+@register
+class NoHostCallbacks(Check):
+    id = "P6"
+    title = "no host callbacks or debug prints in step programs"
+    rationale = ("a callback in a compiled step synchronizes device and "
+                 "host every step, defeating async dispatch and the "
+                 "overlapped input pipeline")
+
+    def check_program(self, record):
+        seen = set()
+        for eqn, _bound in walk_eqns(record.jaxpr):
+            name = eqn.primitive.name
+            if name in CALLBACK_PRIMS and name not in seen:
+                seen.add(name)
+                detail = ""
+                cb = eqn.params.get("callback")
+                if cb is not None:
+                    detail = f" ({getattr(cb, '__name__', cb)!r})"
+                yield self.finding(
+                    record,
+                    f"host callback primitive {name!r}{detail} inside a "
+                    "compiled step program — remove it or move it to the "
+                    "host side of the step boundary",
+                )

@@ -1,0 +1,81 @@
+"""P2/P3: collective axis hygiene.
+
+P2 — every collective's named axes must exist in the mesh the program
+was built over AND be bound by an enclosing shard_map at the reduce
+site. A collective over a foreign axis name means the program and its
+mesh have forked (a copy-pasted region built against a different mesh
+layout) — it traces fine in its own world and deadlocks or mis-reduces
+in this one.
+
+P3 — no value is sum-reduced twice over the same axis: the
+double-reduced gradient (an inline pmean left in front of the gradsync
+reduce) scales grads by an extra 1/n and is invisible to tests that only
+check for finiteness. Taint-based: see jaxpr_utils.double_sum_reduces
+for why a forward-pass psum does NOT taint gradients computed from it.
+"""
+
+from __future__ import annotations
+
+from tools.progcheck.jaxpr_utils import (
+    COLLECTIVE_PRIMS,
+    double_sum_reduces,
+    named_axes,
+    walk_eqns,
+)
+from tools.progcheck.registry import Check, register
+
+
+@register
+class CollectiveAxes(Check):
+    id = "P2"
+    title = "collective axes exist in the program's mesh"
+    rationale = ("a collective over an axis the mesh doesn't define means "
+                 "program and mesh have forked — it mis-reduces or "
+                 "deadlocks on the hardware the mesh actually describes")
+
+    def check_program(self, record):
+        mesh_axes = set(record.meta.get("mesh_axes", ()))
+        seen = set()
+        for eqn, bound in walk_eqns(record.jaxpr):
+            if eqn.primitive.name not in COLLECTIVE_PRIMS:
+                continue
+            for ax in named_axes(eqn):
+                if mesh_axes and ax not in mesh_axes and (eqn.primitive.name, ax) not in seen:
+                    seen.add((eqn.primitive.name, ax))
+                    yield self.finding(
+                        record,
+                        f"{eqn.primitive.name} over axis {ax!r} which the "
+                        f"mesh does not define (mesh axes: "
+                        f"{sorted(mesh_axes)})",
+                    )
+                elif ax not in bound and (eqn.primitive.name, ax, "unbound") not in seen:
+                    seen.add((eqn.primitive.name, ax, "unbound"))
+                    yield self.finding(
+                        record,
+                        f"{eqn.primitive.name} over axis {ax!r} outside any "
+                        "shard_map binding it — the reduce has no device "
+                        "group to run over",
+                    )
+
+
+@register
+class DoubleReduce(Check):
+    id = "P3"
+    title = "gradients are sum-reduced exactly once"
+    rationale = ("a second psum/pmean over an already-reduced value "
+                 "rescales it by the mesh size — the classic inline-pmean-"
+                 "before-gradsync regression, invisible to finiteness tests")
+
+    def check_program(self, record):
+        seen = set()
+        for prim, axis in double_sum_reduces(record.jaxpr):
+            if (prim, axis) in seen:
+                continue
+            seen.add((prim, axis))
+            yield self.finding(
+                record,
+                f"{prim} over axis {axis!r} consumes a value already "
+                "sum-reduced over that axis — the operand is reduced "
+                "twice (grads through gradsync must meet exactly one "
+                "collective)",
+            )

@@ -1,0 +1,38 @@
+"""P9: the compile set stays bounded.
+
+serve's zero-recompile contract (ISSUE 5) generalized to training: a
+family that promises a FIXED program ladder (the serve buckets, the
+h2d_trim 64-rounded canvas shapes) must enumerate no more distinct
+shape signatures than its declared bound. A new uncontrolled shape here
+is tomorrow's multi-second compile stall under load — caught at audit
+time instead of at p99.
+"""
+
+from __future__ import annotations
+
+from tools.progcheck.registry import Check, register
+
+
+@register
+class BoundedCompileSet(Check):
+    id = "P9"
+    title = "program families stay within their compile-set bound"
+    rationale = ("every distinct input shape is a compile; a family that "
+                 "outgrows its declared ladder recompiles under load — "
+                 "the stall serve's bucket design exists to prevent")
+
+    def finalize(self, inventory):
+        by_family: dict[str, list] = {}
+        for rec in inventory:
+            if "max_programs" in rec.meta:
+                by_family.setdefault(rec.family, []).append(rec)
+        for family, recs in sorted(by_family.items()):
+            bound = max(r.meta["max_programs"] for r in recs)
+            signatures = {r.shape_signature for r in recs}
+            if len(signatures) > bound:
+                yield self.finding(
+                    family,
+                    f"{len(signatures)} distinct compiled shapes but the "
+                    f"declared bound is {bound} — the compile set is no "
+                    "longer closed (a shape outside the ladder slipped in)",
+                )

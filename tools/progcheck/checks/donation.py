@@ -1,0 +1,56 @@
+"""P7: donated buffers really alias an output.
+
+The train step donates its state so XLA updates params/queue in place in
+HBM. Donation is only an ALIAS REQUEST: a donated input with no
+shape/dtype-matching output silently degrades to a copy (jax warns once,
+at lower time, on a machine nobody watches) — doubling the state's HBM
+footprint exactly where it hurts. This check makes the aliasing budget a
+gate: every donated input aval must be coverable by a distinct output
+aval.
+
+("Read after donation" from the CALLER's side is enforced by the runtime
+itself — jax poisons donated buffers; what the runtime does NOT enforce
+is that the donation bought anything.)
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from tools.progcheck.registry import Check, register
+
+
+@register
+class DonationAliases(Check):
+    id = "P7"
+    title = "donated inputs alias a matching output"
+    rationale = ("a donated buffer with no matching output silently "
+                 "becomes a copy — the state's HBM footprint doubles and "
+                 "the only witness is a lower-time warning nobody reads")
+    families = ("train", "v3", "aug_step")
+
+    def check_program(self, record):
+        if not record.donated:
+            return
+        jaxpr = record.jaxpr.jaxpr
+        donated_avals = [
+            v.aval for v, d in zip(jaxpr.invars, record.donated) if d
+        ]
+        outs = Counter(
+            (tuple(v.aval.shape), str(v.aval.dtype)) for v in jaxpr.outvars
+        )
+        unmatched = []
+        for aval in donated_avals:
+            key = (tuple(aval.shape), str(aval.dtype))
+            if outs.get(key, 0) > 0:
+                outs[key] -= 1
+            else:
+                unmatched.append(aval)
+        if unmatched:
+            sample = ", ".join(str(a) for a in unmatched[:3])
+            yield self.finding(
+                record,
+                f"{len(unmatched)} donated input(s) cannot alias any "
+                f"output (no shape/dtype match): {sample} — the donation "
+                "silently degrades to a copy",
+            )

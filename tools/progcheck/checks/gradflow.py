@@ -1,0 +1,50 @@
+"""P1: no differentiable path from the loss into the key encoder / queue.
+
+THE MoCo contract (He et al.): the key encoder moves only by EMA, the
+queue only by enqueue — gradients must never reach either. The probe
+programs (train_step.build_grad_probe / v3_step.build_v3_grad_probe)
+differentiate the production key-path + loss code w.r.t. the query
+params AND the key params AND the queue; with the key branch's
+stop_gradient in place, the key/queue gradients are SYMBOLIC zeros, so
+in the jaxpr those outputs depend on no program input. Deleting the
+stop_gradient gives them real data paths — which this check sees
+immediately, without running a single flop.
+
+The flow side is the vacuity guard: if the QUERY grads also depended on
+nothing, the probe would be auditing a constant function and a pass
+would be meaningless.
+"""
+
+from __future__ import annotations
+
+from tools.progcheck.jaxpr_utils import input_dependence
+from tools.progcheck.registry import Check, register
+
+
+@register
+class GradFlow(Check):
+    id = "P1"
+    title = "no gradient reaches the key encoder or the queue"
+    rationale = ("MoCo's key encoder moves only by EMA and the queue only "
+                 "by enqueue; a differentiable path into either silently "
+                 "turns the method into end-to-end contrastive training")
+    families = ("probe",)
+
+    def check_program(self, record):
+        deps = input_dependence(record.jaxpr)
+        for group, start, end in record.meta.get("zero_groups", ()):
+            leaky = [i for i in range(start, min(end, len(deps))) if deps[i]]
+            if leaky:
+                yield self.finding(
+                    record,
+                    f"gradient flows into {group}: {len(leaky)} of "
+                    f"{end - start} grad outputs depend on program inputs "
+                    "— the key-branch stop_gradient is gone or bypassed",
+                )
+        for group, start, end in record.meta.get("flow_groups", ()):
+            if not any(deps[i] for i in range(start, min(end, len(deps)))):
+                yield self.finding(
+                    record,
+                    f"no {group} gradient depends on any input — the probe "
+                    "is differentiating a constant; the audit is vacuous",
+                )

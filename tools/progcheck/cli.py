@@ -1,0 +1,171 @@
+"""progcheck CLI.
+
+    python -m tools.progcheck                      # audit the full surface
+        --json                 machine output (schema below)
+        --families train,v3    limit the traced surface
+        --select P1,P3         run only these checks
+        --list-checks          print the check table and exit
+        --baseline PATH        subtract grandfathered findings
+        --write-baseline PATH  snapshot current findings and exit 0
+        --inventory PATH       also write the program inventory JSON
+        --write-golden PATH    write the train/v3 invariant-summary golden
+        --fake-devices N       mesh size (default 8 fake CPU devices)
+        --no-flops             skip XLA cost_analysis (faster)
+
+Exit codes: 0 clean, 1 findings, 2 usage/config error.
+
+JSON schema (version 1):
+    {"version": 1, "tool": "progcheck", "programs_audited": N,
+     "findings": [{"program","check","severity","message"}...],
+     "baselined": N, "inventory": {...}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _bootstrap_path() -> None:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+
+def main(argv: list[str] | None = None) -> int:
+    _bootstrap_path()
+    from tools.progcheck.registry import all_checks
+
+    parser = argparse.ArgumentParser(
+        prog="progcheck", description="moco_tpu jaxpr-level program auditor")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument("--families", default=None,
+                        help="comma-separated program families")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated check ids")
+    parser.add_argument("--list-checks", action="store_true")
+    parser.add_argument("--baseline", default=None)
+    parser.add_argument("--write-baseline", default=None)
+    parser.add_argument("--inventory", default=None)
+    parser.add_argument("--write-golden", default=None)
+    parser.add_argument("--fake-devices", type=int, default=8)
+    parser.add_argument("--no-flops", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for cid, cls in sorted(all_checks().items(),
+                               key=lambda kv: (len(kv[0]), kv[0])):
+            scope = ",".join(cls.families) if cls.families else "all programs"
+            print(f"{cid:<4} [{cls.severity}] {cls.title}  ({scope})")
+            print(f"     why: {cls.rationale}")
+        return 0
+
+    select = None
+    if args.select:
+        select = tuple(s.strip() for s in args.select.split(",") if s.strip())
+        unknown = [s for s in select if s not in all_checks()]
+        if unknown:
+            print(f"progcheck: unknown check id(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+    families = None
+    if args.families:
+        families = tuple(f.strip() for f in args.families.split(",")
+                         if f.strip())
+
+    # the program surface needs a multi-device mesh; fake CPU devices give
+    # real collective semantics (the test-suite convention). Must happen
+    # before the first backend query, so before build_surface imports land.
+    if args.fake_devices:
+        from moco_tpu.parallel.mesh import force_cpu_devices
+
+        force_cpu_devices(args.fake_devices)
+
+    from moco_tpu.parallel.mesh import create_mesh
+    from tools.progcheck.engine import Engine
+    from tools.progcheck.inventory import (
+        golden_json,
+        inventory_json,
+        write_inventory,
+    )
+    from tools.progcheck.surface import build_surface
+
+    t0 = time.perf_counter()
+    try:
+        mesh = create_mesh()
+        records = build_surface(mesh=mesh, families=families,
+                                with_cost=not args.no_flops)
+    except ValueError as e:
+        print(f"progcheck: {e}", file=sys.stderr)
+        return 2
+    trace_s = time.perf_counter() - t0
+
+    if args.inventory:
+        write_inventory(args.inventory, records, mesh.size)
+    if args.write_golden:
+        with open(args.write_golden, "w", encoding="utf-8") as f:
+            json.dump(golden_json(records, mesh.size), f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
+
+    engine = Engine(select=select)
+    if args.write_baseline:
+        result = engine.run(records, baseline_path=None)
+        from tools.mocolint import baseline as baseline_mod
+
+        n = baseline_mod.write(args.write_baseline, result.findings)
+        print(f"wrote baseline of {n} finding(s) to {args.write_baseline}")
+        return 0
+    try:
+        result = engine.run(records, baseline_path=args.baseline)
+    except (OSError, ValueError) as e:
+        print(f"progcheck: {e}", file=sys.stderr)
+        return 2
+    audit_s = time.perf_counter() - t0 - trace_s
+
+    if select:
+        # an explicitly-selected check that examined zero programs is a
+        # vacuous audit, not a pass — say so (family-scoped checks need
+        # their family in --families; P1 needs "probe", P8 "gradsync")
+        vacuous = [cid for cid in select
+                   if result.checks_applied.get(cid, 0) == 0]
+        if vacuous:
+            print(
+                f"progcheck: warning: selected check(s) "
+                f"{', '.join(vacuous)} matched no program in the traced "
+                "surface — nothing was verified by them (add their "
+                "family to --families)",
+                file=sys.stderr,
+            )
+
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "tool": "progcheck",
+            "programs_audited": result.programs_audited,
+            "trace_s": round(trace_s, 3),
+            "audit_s": round(audit_s, 3),
+            "findings": [f.json_obj() for f in result.findings],
+            "baselined": len(result.baselined),
+            "inventory": inventory_json(records, mesh.size),
+        }, indent=2))
+        return 1 if result.findings else 0
+
+    for f in result.findings:
+        print(f.human())
+    tail = f" ({len(result.baselined)} baselined)" if result.baselined else ""
+    if result.findings:
+        print(f"{len(result.findings)} finding(s) over "
+              f"{result.programs_audited} program(s){tail}")
+        return 1
+    print(f"progcheck clean: {result.programs_audited} program(s) audited "
+          f"in {trace_s + audit_s:.1f} s (trace {trace_s:.1f} s){tail}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""progcheck's run loop: every check over every record, plus baseline.
+
+Mirrors mocolint's Engine shape (instantiate checks fresh, run per-item
+hooks then finalize, subtract the committed baseline) so adding a check
+feels identical to adding a lint rule — the difference is only what the
+hooks receive: a traced ProgramRecord instead of a parsed file.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tools.mocolint import baseline as baseline_mod
+from tools.progcheck.finding import Finding, sort_findings
+from tools.progcheck.registry import all_checks
+
+
+@dataclasses.dataclass
+class Result:
+    findings: list
+    baselined: list
+    programs_audited: int
+    # check id -> programs it actually examined; a SELECTED check that
+    # applied to zero programs is a silently-vacuous audit the caller
+    # should surface (the CLI warns)
+    checks_applied: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+class Engine:
+    def __init__(self, select: tuple[str, ...] | None = None):
+        classes = all_checks()
+        self._ids = [cid for cid in sorted(classes)
+                     if select is None or cid in select]
+        self._classes = classes
+
+    def run(self, records, baseline_path: str | None = None) -> Result:
+        # fresh instances per run (the registry contract): a check may
+        # accumulate state across check_program() calls and flush it in
+        # finalize() without leaking into the next run
+        checks = [self._classes[cid]() for cid in self._ids]
+        findings: list[Finding] = []
+        applied: dict[str, int] = {}
+        for check in checks:
+            applied[check.id] = 0
+            for rec in records:
+                if check.applies(rec):
+                    applied[check.id] += 1
+                    findings.extend(check.check_program(rec))
+            findings.extend(check.finalize(records))
+        baselined: list[Finding] = []
+        if baseline_path:
+            counts = baseline_mod.load(baseline_path)
+            kept, baselined = baseline_mod.apply(sort_findings(findings),
+                                                 counts)
+            findings = kept
+        return Result(
+            findings=sort_findings(findings),
+            baselined=baselined,
+            programs_audited=len(records),
+            checks_applied=applied,
+        )

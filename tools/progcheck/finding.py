@@ -1,0 +1,40 @@
+"""progcheck's finding record.
+
+Field-compatible with mocolint's Finding (path/line/rule/message) so the
+baseline machinery (tools/mocolint/baseline.py) fingerprints both — but a
+progcheck finding anchors to a PROGRAM, not a source line: `path` holds
+the program name (e.g. "train/quantized") and `line` is always 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str            # program name ("family/mode" or "family/variant")
+    line: int            # always 0 — programs have no lines
+    rule: str            # check id (P1..P9)
+    message: str
+    col: int = 0
+    severity: str = "error"
+
+    @property
+    def program(self) -> str:
+        return self.path
+
+    def human(self) -> str:
+        return f"{self.path}: {self.rule} {self.message}"
+
+    def json_obj(self) -> dict:
+        return {
+            "program": self.path,
+            "check": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+        }
+
+
+def sort_findings(findings: list[Finding]) -> list[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.rule, f.message))

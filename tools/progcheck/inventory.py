@@ -1,0 +1,148 @@
+"""The program inventory: one record per compiled-program candidate.
+
+This is the artifact half of progcheck (ISSUE 9): the shape signature,
+FLOPs (XLA `cost_analysis` where the build exposes it), and collective
+payload of every program the repo compiles — the seed data for the
+planned CompiledRegistry (ROADMAP item 5), and what
+`tools/telemetry_report.py --programs` folds into bench records so the
+MFUEstimator's analytic FLOPs can be cross-checked against the
+compiler's own count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+from tools.progcheck.jaxpr_utils import collect_collectives, walk_eqns
+
+INVENTORY_VERSION = 1
+
+
+@dataclasses.dataclass
+class ProgramRecord:
+    """One audited program. `jaxpr` is the live ClosedJaxpr the checks
+    walk; everything else serializes into the inventory JSON."""
+
+    name: str                 # "family/mode" — the finding anchor
+    family: str               # train | v3 | probe | gradsync | serve | aug_step | eval
+    mode: str | None          # grad_sync mode / bucket / trim shape
+    jaxpr: Any
+    in_avals: list[str]
+    out_avals: list[str]
+    n_eqns: int
+    collectives: list
+    donated: tuple | None = None   # per-flat-input donation flags
+    flops: float | None = None
+    bytes_accessed: float | None = None
+    analytic_flops: float | None = None  # MFUEstimator's count, same config
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def shape_signature(self) -> tuple:
+        return tuple(self.in_avals)
+
+    def collective_bytes(self) -> int:
+        return sum(c.operand_bytes for c in self.collectives)
+
+    def json_obj(self) -> dict:
+        obj = {
+            "name": self.name,
+            "family": self.family,
+            "mode": self.mode,
+            "in_avals": self.in_avals,
+            "out_avals_n": len(self.out_avals),
+            "n_eqns": self.n_eqns,
+            "collectives": [c.json_obj() for c in self.collectives],
+            "collective_bytes": self.collective_bytes(),
+        }
+        if self.donated is not None:
+            obj["donated_inputs"] = int(sum(bool(d) for d in self.donated))
+        if self.flops is not None:
+            obj["flops"] = self.flops
+        if self.bytes_accessed is not None:
+            obj["bytes_accessed"] = self.bytes_accessed
+        if self.analytic_flops is not None:
+            obj["analytic_flops"] = self.analytic_flops
+            if self.flops:
+                obj["flops_vs_analytic"] = round(self.flops / self.analytic_flops, 4)
+        for key in ("sync_bytes_per_step", "buckets", "max_programs"):
+            if key in self.meta:
+                obj[key] = self.meta[key]
+        return obj
+
+
+def make_record(name: str, family: str, mode: str | None, closed_jaxpr,
+                donated=None, meta: dict | None = None) -> ProgramRecord:
+    jaxpr = closed_jaxpr.jaxpr if hasattr(closed_jaxpr, "jaxpr") else closed_jaxpr
+    n_eqns = sum(1 for _ in walk_eqns(closed_jaxpr))
+    return ProgramRecord(
+        name=name,
+        family=family,
+        mode=mode,
+        jaxpr=closed_jaxpr,
+        in_avals=[str(v.aval) for v in jaxpr.invars],
+        out_avals=[str(v.aval) for v in jaxpr.outvars],
+        n_eqns=n_eqns,
+        collectives=collect_collectives(closed_jaxpr),
+        donated=donated,
+        meta=dict(meta or {}),
+    )
+
+
+def inventory_json(records: list[ProgramRecord], mesh_size: int) -> dict:
+    by_family: dict[str, int] = {}
+    for r in records:
+        by_family[r.family] = by_family.get(r.family, 0) + 1
+    return {
+        "version": INVENTORY_VERSION,
+        "tool": "progcheck",
+        "mesh_size": mesh_size,
+        "program_count": len(records),
+        "by_family": dict(sorted(by_family.items())),
+        "programs": [r.json_obj() for r in records],
+    }
+
+
+def write_inventory(path: str, records: list[ProgramRecord],
+                    mesh_size: int) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(inventory_json(records, mesh_size), f, indent=2)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# golden invariant summaries (satellite: refactors diff loudly)
+# ---------------------------------------------------------------------------
+
+
+def invariant_summary(record: ProgramRecord) -> dict:
+    """The parts of a step program a refactor must not silently change:
+    collective count/shape/payload and the donation/output contract.
+    FLOPs and eqn counts are deliberately absent — they churn with every
+    fusion-level change and would make the golden noisy."""
+    colls = sorted(
+        (dataclasses.asdict(c) for c in record.collectives),
+        key=lambda c: (c["prim"], c["axes"], c["operand_dtypes"],
+                       c["operand_elems"]),
+    )
+    return {
+        "collectives": colls,
+        "collective_bytes": record.collective_bytes(),
+        "n_outputs": len(record.out_avals),
+        "donated_inputs": (int(sum(bool(d) for d in record.donated))
+                           if record.donated is not None else 0),
+    }
+
+
+def golden_json(records: list[ProgramRecord], mesh_size: int) -> dict:
+    return {
+        "version": INVENTORY_VERSION,
+        "mesh_size": mesh_size,
+        "programs": {
+            r.name: invariant_summary(r)
+            for r in sorted(records, key=lambda r: r.name)
+            if r.family in ("train", "v3")
+        },
+    }

@@ -1,0 +1,65 @@
+"""Check registry: every program check is a plugin class registered by id.
+
+A check declares WHAT invariant it verifies (metadata: id, title,
+severity, rationale — `--list-checks` renders them) and implements up to
+two hooks, both generators of `Finding`:
+
+  check_program(record)  — called once per ProgramRecord whose family
+                           appears in `families` (empty = every program).
+                           The common case: one jaxpr, one verdict.
+  finalize(inventory)    — called once per run with every record, for
+                           cross-program invariants (the bounded
+                           compile-set check).
+
+Checks are instantiated fresh per Engine run (mirroring mocolint's rule
+contract), so a check may accumulate state across check_program() calls
+and flush it in finalize().
+"""
+
+from __future__ import annotations
+
+from tools.progcheck.finding import Finding
+
+
+class Check:
+    """Base class; subclasses override the metadata and hooks."""
+
+    id: str = ""
+    title: str = ""
+    severity: str = "error"
+    rationale: str = ""
+    families: tuple = ()   # empty = audit every program
+
+    def applies(self, record) -> bool:
+        return not self.families or record.family in self.families
+
+    def check_program(self, record):
+        return ()
+
+    def finalize(self, inventory):
+        return ()
+
+    def finding(self, record_or_name, message: str) -> Finding:
+        name = getattr(record_or_name, "name", record_or_name)
+        return Finding(path=name, line=0, rule=self.id, message=message,
+                       severity=self.severity)
+
+
+_CHECKS: dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator: adds the check to the global registry."""
+    if not cls.id:
+        raise ValueError(f"check {cls.__name__} has no id")
+    if cls.id in _CHECKS:
+        raise ValueError(f"duplicate check id {cls.id}")
+    _CHECKS[cls.id] = cls
+    return cls
+
+
+def all_checks() -> dict[str, type]:
+    """id -> class, after ensuring the built-in check modules loaded."""
+    import tools.progcheck.checks  # noqa: F401  (registration side effect)
+
+    return dict(_CHECKS)

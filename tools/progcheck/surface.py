@@ -1,0 +1,400 @@
+"""Enumerate the repo's compiled-program surface (ISSUE 9 part a).
+
+Every program the framework compiles, rebuilt ABSTRACTLY on a tiny proxy
+config and traced with `jax.make_jaxpr` over `jax.eval_shape`-built
+states — no weights are initialized for the train/v3/probe families, no
+program executes, so the full surface traces in seconds on the CPU
+backend:
+
+  train/<mode>     — the v1/v2 fused-queue step under each grad_sync mode
+  v3/<mode>        — the queue-free symmetric step under each mode
+  probe/train,v3   — the grad-flow audit programs (train_step.
+                     build_grad_probe / v3_step.build_v3_grad_probe)
+  gradsync/<mode>  — the isolated region reduce (GradSync.
+                     audit_region_program), the wire-bytes check's input
+  serve/bucket<N>  — the EmbeddingEngine program at each ladder bucket
+  aug_step/<HxW>   — the fused aug+step program at each h2d_trim canvas
+                     shape (trim rounds to 64, so the variant set is the
+                     bounded compile set the P9 check pins)
+  eval/feature,knn — the frozen-feature eval forward + kNN vote programs
+
+The proxy uses `resnet_tiny` at 16 px — program STRUCTURE (collectives,
+grad topology, dtype policy, donation) is what the checks audit, and it
+is arch-size-independent; `cost_analysis` FLOPs are proxy-sized and
+labeled as such in the inventory.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+FAMILIES = ("train", "v3", "probe", "gradsync", "serve", "aug_step", "eval")
+
+# the tiny proxy (mirrors tests/test_gradsync.py)
+B, IMG, DIM, K = 16, 16, 16, 64
+CANVAS = 128          # aug_step staging canvas; h2d_trim grid = {64,128}²
+SERVE_BUCKETS = (1, 8, 32, 128)
+EVAL_BATCH = 32
+GRAD_SYNC_KNOBS = dict(grad_sync_bucket_mb=0.05, grad_sync_topk=0.25,
+                       grad_sync_cadence=1)
+
+
+def _proxy_config(**kw):
+    from moco_tpu.config import PretrainConfig
+
+    base = dict(variant="v1", arch="resnet_tiny", cifar_stem=True,
+                num_negatives=K, embed_dim=DIM, batch_size=B, epochs=2,
+                lr=0.1, image_size=IMG, dataset="synthetic")
+    base.update(kw)
+    return PretrainConfig(**base)
+
+
+def _cost(lowerable, args, with_cost: bool):
+    """(flops, bytes_accessed) from XLA's own cost model, or (None, None)
+    when the build doesn't expose it — never fabricated."""
+    if not with_cost:
+        return None, None
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ca = lowerable.lower(*args).cost_analysis()
+        if isinstance(ca, dict):
+            return (float(ca["flops"]) if "flops" in ca else None,
+                    float(ca.get("bytes accessed"))
+                    if "bytes accessed" in ca else None)
+    except Exception:  # jax version surface: NotImplementedError,
+        return None, None  # XlaRuntimeError, KeyError... — cost is optional
+    return None, None
+
+
+def _donated(closed_jaxpr):
+    """Flat donation flags when the program is one pjit (a jitted fn with
+    donate_argnums traces to exactly that)."""
+    jaxpr = closed_jaxpr.jaxpr
+    if len(jaxpr.eqns) == 1 and jaxpr.eqns[0].primitive.name == "pjit":
+        don = jaxpr.eqns[0].params.get("donated_invars")
+        if don is not None and any(don):
+            return tuple(don)
+    return None
+
+
+def _state_shapes(config, mesh):
+    """eval_shape the full TrainState (+ gradsync accumulators) — abstract
+    init: no weights materialize."""
+    import jax
+
+    from moco_tpu.parallel.gradsync import GradSync
+    from moco_tpu.train_step import build_encoder, build_optimizer
+
+    model = build_encoder(config)
+    tx, sched = build_optimizer(config, 8)
+    gs = GradSync(config, mesh.size)
+
+    def build():
+        if config.variant == "v3":
+            from moco_tpu.v3_step import create_v3_train_state
+
+            state = create_v3_train_state(
+                jax.random.key(0), model, tx,
+                (B // mesh.size, IMG, IMG, 3),
+            )
+        else:
+            from moco_tpu.train_state import create_train_state
+
+            state = create_train_state(
+                jax.random.key(0), model, tx, (B // mesh.size, IMG, IMG, 3),
+                K, DIM,
+            )
+        return gs.attach(state, mesh)
+
+    return jax.eval_shape(build), model, tx, sched
+
+
+def _step_records(mesh, with_cost, family):
+    import jax
+    import jax.numpy as jnp
+
+    from moco_tpu.train_step import build_train_step
+    from tools.progcheck.inventory import make_record
+
+    variant = "v1" if family == "train" else "v3"
+    records = []
+    im = jax.ShapeDtypeStruct((B, IMG, IMG, 3), jnp.float32)
+    for mode in ("fused", "bucketed", "quantized", "demo"):
+        config = _proxy_config(variant=variant, grad_sync=mode,
+                               **GRAD_SYNC_KNOBS)
+        state, model, tx, sched = _state_shapes(config, mesh)
+        step = build_train_step(config, model, tx, mesh, 8, sched)
+        closed = jax.make_jaxpr(step)(state, im, im)
+        flops, nbytes = _cost(step, (state, im, im), with_cost)
+        rec = make_record(
+            f"{family}/{mode}", family, mode, closed,
+            donated=_donated(closed),
+            meta={"mesh_axes": tuple(str(a) for a in mesh.axis_names)},
+        )
+        # cost_analysis sees the PER-PARTITION program of an SPMD step;
+        # scale to the whole global batch so the number is comparable to
+        # MFUEstimator's analytic per-step count (ratio ≈ 1 expected:
+        # the compiler counts every op, the analytic model only encoder
+        # passes — agreement within tens of % is healthy, an order of
+        # magnitude means one side broke)
+        rec.flops = flops * mesh.size if flops is not None else None
+        rec.bytes_accessed = nbytes
+        if flops is not None:
+            from moco_tpu.telemetry.mfu import train_step_flops
+
+            try:
+                rec.analytic_flops = float(train_step_flops(config))
+            except (KeyError, ValueError):
+                rec.analytic_flops = None
+        records.append(rec)
+    return records
+
+
+def _probe_records(mesh):
+    import jax
+    import jax.numpy as jnp
+
+    from tools.progcheck.inventory import make_record
+
+    records = []
+    im = jax.ShapeDtypeStruct((B, IMG, IMG, 3), jnp.float32)
+
+    # v1/v2 probe: grads w.r.t. (params_q, params_k, queue)
+    config = _proxy_config()
+    state, model, tx, _ = _state_shapes(config, mesh)
+    from moco_tpu.train_step import build_grad_probe
+
+    probe = build_grad_probe(config, model, mesh)
+    key = jax.eval_shape(lambda: jax.random.key(0))
+    args = (state.params_q, state.params_k, state.batch_stats_q,
+            state.batch_stats_k, state.queue, im, im, key)
+    closed = jax.make_jaxpr(probe)(*args)
+    n_q = len(jax.tree.leaves(state.params_q))
+    n_k = len(jax.tree.leaves(state.params_k))
+    records.append(make_record(
+        "probe/train", "probe", None, closed,
+        meta={
+            "mesh_axes": tuple(str(a) for a in mesh.axis_names),
+            # flat OUTPUT leaf ranges: (g_q, g_k, g_queue)
+            "flow_groups": [("params_q", 0, n_q)],
+            "zero_groups": [("params_k", n_q, n_q + n_k),
+                            ("queue", n_q + n_k, n_q + n_k + 1)],
+        },
+    ))
+
+    # v3 probe: grads w.r.t. (params_q, params_k)
+    config = _proxy_config(variant="v3")
+    state, model, tx, _ = _state_shapes(config, mesh)
+    from moco_tpu.v3_step import build_v3_grad_probe
+
+    probe = build_v3_grad_probe(config, model, mesh)
+    args = (state.params_q, state.params_k, state.batch_stats_q,
+            state.batch_stats_k, im, im)
+    closed = jax.make_jaxpr(probe)(*args)
+    n_q = len(jax.tree.leaves(state.params_q))
+    n_k = len(jax.tree.leaves(state.params_k))
+    records.append(make_record(
+        "probe/v3", "probe", None, closed,
+        meta={
+            "mesh_axes": tuple(str(a) for a in mesh.axis_names),
+            "flow_groups": [("params_q", 0, n_q)],
+            "zero_groups": [("params_k", n_q, n_q + n_k)],
+        },
+    ))
+    return records
+
+
+def _gradsync_records(mesh):
+    import jax
+    import jax.numpy as jnp
+
+    from moco_tpu.parallel.gradsync import GradSync
+    from tools.progcheck.inventory import make_record
+
+    # a grads-shaped tree exercising the whole dtype policy: f32, bf16,
+    # and an exact-sum integer leaf (scalar leaves are reserved for the
+    # probe — see the wire-bytes check's probe exclusion)
+    params = {
+        "w": jnp.zeros((300,), jnp.float32),
+        "b": jnp.zeros((12, 12), jnp.float32),
+        "h": jnp.zeros((64,), jnp.bfloat16),
+        "count": jnp.zeros((4,), jnp.int32),
+    }
+    records = []
+    for mode in ("fused", "bucketed", "quantized", "demo"):
+        config = _proxy_config(grad_sync=mode, **GRAD_SYNC_KNOBS)
+        gs = GradSync(config, mesh.size)
+        fn, args, payload_shape = gs.audit_region_program(params, mesh)
+        closed = jax.make_jaxpr(fn)(*args)
+        records.append(make_record(
+            f"gradsync/{mode}", "gradsync", mode, closed,
+            meta={
+                "mesh_axes": tuple(str(a) for a in mesh.axis_names),
+                "gradsync": gs,
+                "payload_shape": payload_shape,
+                "mesh_size": mesh.size,
+                "sync_bytes_per_step": gs.sync_bytes_per_step(),
+            },
+        ))
+    return records
+
+
+def _serve_records(mesh, with_cost):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from moco_tpu.serve.engine import EmbeddingEngine
+    from moco_tpu.train_step import build_encoder
+    from tools.progcheck.inventory import make_record
+
+    config = _proxy_config()
+    model = build_encoder(config)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, IMG, IMG, 3), jnp.float32),
+                           train=False)
+    engine = EmbeddingEngine(
+        model, variables["params"], variables.get("batch_stats", {}),
+        image_size=IMG, buckets=SERVE_BUCKETS,
+    )
+    records = []
+    for bucket in engine.buckets:
+        images = jax.ShapeDtypeStruct((bucket, IMG, IMG, 3), np.uint8)
+        args = (engine.params, engine.batch_stats, images)
+        closed = jax.make_jaxpr(engine._jitted)(*args)
+        flops, nbytes = _cost(engine._jitted, args, with_cost)
+        rec = make_record(
+            f"serve/bucket{bucket}", "serve", str(bucket), closed,
+            meta={
+                "mesh_axes": tuple(str(a) for a in mesh.axis_names),
+                "max_programs": len(engine.buckets),
+                "buckets": list(engine.buckets),
+            },
+        )
+        rec.flops, rec.bytes_accessed = flops, nbytes
+        records.append(rec)
+    return records
+
+
+def _aug_step_records(mesh, with_cost):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from moco_tpu.data.augment import (
+        aug_config_for,
+        build_two_crops_sharded,
+        with_dtype,
+    )
+    from moco_tpu.train_step import build_fused_step, build_train_step
+    from tools.progcheck.inventory import make_record
+
+    config = _proxy_config()
+    state, model, tx, sched = _state_shapes(config, mesh)
+    step = build_train_step(config, model, tx, mesh, 8, sched)
+    aug_cfg = with_dtype(aug_config_for(config), config.compute_dtype)
+    two_crops = build_two_crops_sharded(aug_cfg, mesh)
+    fused = build_fused_step(step, two_crops, jax.random.key(0))
+    # the h2d_trim bounded compile set: trim rounds each canvas dim up to
+    # 64, so a CANVAS staging canvas admits exactly (CANVAS//64)² shapes
+    sizes = list(range(64, CANVAS + 1, 64))
+    max_programs = len(sizes) ** 2
+    records = []
+    step_sds = jax.ShapeDtypeStruct((), jnp.int32)
+    extents = jax.ShapeDtypeStruct((B, 2), np.int32)
+    for th in sizes:
+        for tw in sizes:
+            imgs = jax.ShapeDtypeStruct((B, th, tw, 3), np.uint8)
+            args = (state, imgs, extents, step_sds)
+            closed = jax.make_jaxpr(fused)(*args)
+            flops, nbytes = _cost(fused, args, with_cost)
+            rec = make_record(
+                f"aug_step/{th}x{tw}", "aug_step", f"{th}x{tw}", closed,
+                donated=_donated(closed),
+                meta={
+                    "mesh_axes": tuple(str(a) for a in mesh.axis_names),
+                    "max_programs": max_programs,
+                },
+            )
+            rec.flops, rec.bytes_accessed = flops, nbytes
+            records.append(rec)
+    return records
+
+
+def _eval_records(mesh, with_cost):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from moco_tpu.evals.knn import build_feature_fn
+    from moco_tpu.ops.knn import _knn_predict_prenormalized
+    from moco_tpu.train_step import build_encoder
+    from tools.progcheck.inventory import make_record
+
+    config = _proxy_config()
+    model = build_encoder(config)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.key(0),
+                           jnp.zeros((1, IMG, IMG, 3), jnp.float32),
+                           train=False)
+    )
+    feature_fn = build_feature_fn(model)
+    images = jax.ShapeDtypeStruct((EVAL_BATCH, IMG, IMG, 3), jnp.float32)
+    args = (variables["params"], variables.get("batch_stats", {}), images)
+    closed = jax.make_jaxpr(feature_fn)(*args)
+    flops, nbytes = _cost(feature_fn, args, with_cost)
+    rec = make_record(
+        "eval/feature", "eval", None, closed,
+        meta={"mesh_axes": tuple(str(a) for a in mesh.axis_names)},
+    )
+    rec.flops, rec.bytes_accessed = flops, nbytes
+    records = [rec]
+
+    feats = jax.ShapeDtypeStruct((EVAL_BATCH, DIM), jnp.float32)
+    bank = jax.ShapeDtypeStruct((256, DIM), jnp.float32)
+    labels = jax.ShapeDtypeStruct((256,), np.int32)
+    for name, chunk in (("knn", None), ("knn_chunked", 64)):
+        def knn(f, b, l, _chunk=chunk):
+            return _knn_predict_prenormalized(
+                f, b, l, num_classes=10, k=8, bank_chunk=_chunk
+            )
+
+        closed = jax.make_jaxpr(knn)(feats, bank, labels)
+        records.append(make_record(
+            f"eval/{name}", "eval", None, closed,
+            meta={"mesh_axes": tuple(str(a) for a in mesh.axis_names)},
+        ))
+    return records
+
+
+def build_surface(mesh=None, families=None, with_cost: bool = True):
+    """Trace the full program surface; returns `list[ProgramRecord]`.
+
+    `families` limits the work (tests audit one family at a time); order
+    is deterministic. Requires an initialized CPU/TPU backend — the CLI
+    forces 8 fake CPU devices before the first jax import."""
+    from moco_tpu.parallel.mesh import create_mesh
+
+    if mesh is None:
+        mesh = create_mesh()
+    wanted = tuple(families) if families else FAMILIES
+    unknown = set(wanted) - set(FAMILIES)
+    if unknown:
+        raise ValueError(f"unknown families: {sorted(unknown)}")
+    records = []
+    if "train" in wanted:
+        records.extend(_step_records(mesh, with_cost, "train"))
+    if "v3" in wanted:
+        records.extend(_step_records(mesh, with_cost, "v3"))
+    if "probe" in wanted:
+        records.extend(_probe_records(mesh))
+    if "gradsync" in wanted:
+        records.extend(_gradsync_records(mesh))
+    if "serve" in wanted:
+        records.extend(_serve_records(mesh, with_cost))
+    if "aug_step" in wanted:
+        records.extend(_aug_step_records(mesh, with_cost))
+    if "eval" in wanted:
+        records.extend(_eval_records(mesh, with_cost))
+    return records

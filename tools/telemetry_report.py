@@ -239,6 +239,42 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
     return summary
 
 
+def fold_programs(summary: dict, inventory: dict) -> dict:
+    """Fold a progcheck program inventory (`python -m tools.progcheck
+    --inventory`, ISSUE 9) into the summary: program counts, per-mode
+    gradsync payload, and the MFU cross-check — XLA `cost_analysis` FLOPs
+    vs the MFUEstimator's analytic count for the same proxy program, so a
+    drift in the analytic model (the numerator every reported MFU rests
+    on) is visible next to the compiler's own arithmetic."""
+    progs = inventory.get("programs", [])
+    sec: dict = {
+        "count": inventory.get("program_count", len(progs)),
+        "mesh_size": inventory.get("mesh_size"),
+        "by_family": inventory.get("by_family", {}),
+    }
+    sync = {
+        p["mode"]: p["sync_bytes_per_step"]
+        for p in progs
+        if p.get("family") == "gradsync" and "sync_bytes_per_step" in p
+    }
+    if sync:
+        sec["gradsync_bytes_per_step"] = sync
+    cross = [
+        {
+            "name": p["name"],
+            "cost_analysis_flops": p["flops"],
+            "analytic_flops": p["analytic_flops"],
+            "ratio": p.get("flops_vs_analytic"),
+        }
+        for p in progs
+        if p.get("flops") is not None and p.get("analytic_flops")
+    ]
+    if cross:
+        sec["mfu_cross_check"] = cross
+    summary["programs"] = sec
+    return summary
+
+
 def render(summary: dict) -> str:
     """Human-readable report from a summarize() dict."""
     lines = []
@@ -397,6 +433,22 @@ def render(summary: dict) -> str:
                 f"({cache.get('hits', 0)} hit / {cache.get('misses', 0)} "
                 f"miss, {cache.get('entries', 0)} entries)"
             )
+    progs = summary.get("programs")
+    if progs:
+        fams = ", ".join(f"{k}×{v}" for k, v in
+                         sorted(progs.get("by_family", {}).items()))
+        lines.append(f"programs: {progs.get('count', 0)} audited ({fams})")
+        sync = progs.get("gradsync_bytes_per_step")
+        if sync:
+            detail = " · ".join(f"{m} {b} B" for m, b in sorted(sync.items()))
+            lines.append(f"  gradsync payload/step/device: {detail}")
+        for c in progs.get("mfu_cross_check", ())[:4]:
+            lines.append(
+                f"  {c['name']}: cost_analysis "
+                f"{c['cost_analysis_flops'] / 1e6:.1f} MFLOP vs analytic "
+                f"{c['analytic_flops'] / 1e6:.1f} MFLOP"
+                + (f" (×{c['ratio']:.2f})" if c.get("ratio") else "")
+            )
     inc = summary.get("incidents", {})
     if inc:
         detail = ", ".join(f"{k}×{v}" for k, v in sorted(inc.items()))
@@ -533,6 +585,10 @@ def main(argv=None) -> int:
                              "lines as they land (ctrl-C to stop)")
     parser.add_argument("--poll-secs", type=float, default=0.5,
                         help="--follow poll cadence")
+    parser.add_argument("--programs", default=None, metavar="INVENTORY",
+                        help="progcheck --inventory JSON to fold in "
+                             "(program counts, gradsync payload, MFU "
+                             "cross-check)")
     args = parser.parse_args(argv)
     if args.follow:
         try:
@@ -546,6 +602,14 @@ def main(argv=None) -> int:
         print(f"cannot read {args.events}: {e}", file=sys.stderr)
         return 2
     summary = summarize(records, skipped)
+    if args.programs:
+        try:
+            with open(args.programs, encoding="utf-8") as f:
+                fold_programs(summary, json.load(f))
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            print(f"cannot read program inventory {args.programs}: {e}",
+                  file=sys.stderr)
+            return 2
     if args.json:
         print(json.dumps(summary, default=float))
     else:
