@@ -218,7 +218,12 @@ def _orchestrate_body(mode: str, orch: "_Orchestrator") -> None:
         orch.flush()
         return
     if mode == "serve":  # ISSUE 5: warm-bucket serving latency (CPU proxy)
-        orch.best = orch.run("cpu", "serve", 300.0, _CPU_ENV)
+        # ISSUE 10: the explicit --mode serve run (300 s) also measures
+        # the FLEET rows (rps vs replica count through the router, kill
+        # drill). The step-mode serve child below keeps its tight 90 s
+        # cap and skips them — three replica boots don't fit there.
+        orch.best = orch.run("cpu", "serve", 300.0,
+                             {**_CPU_ENV, "MOCO_TPU_BENCH_FLEET": "1"})
         orch.flush()
         return
 
@@ -607,6 +612,18 @@ def bench_serve():
         service.drain()
         frontend.shutdown()
     assert summary["lost"] == 0, f"lost requests: {summary['lost_detail']}"
+    detail = {
+        "concurrency": concurrency,
+        "requests": total,
+        "throughput_rps": summary["throughput_rps"],
+        "latency_ms": summary["latency_ms"],
+        "shed": summary["shed"],
+        "batches": stats["batches"],
+        "occupancy_mean": stats["occupancy_mean"],
+        "buckets": stats["buckets"],
+    }
+    if os.environ.get("MOCO_TPU_BENCH_FLEET"):
+        detail["fleet"] = _bench_serve_fleet(variables, serve_bench)
     print(
         json.dumps(
             {
@@ -615,19 +632,59 @@ def bench_serve():
                 "unit": "ms",
                 "vs_baseline": 0.0,
                 "compile_warmup_s": round(warmup_s, 1),
-                "detail": {
-                    "concurrency": concurrency,
-                    "requests": total,
-                    "throughput_rps": summary["throughput_rps"],
-                    "latency_ms": summary["latency_ms"],
-                    "shed": summary["shed"],
-                    "batches": stats["batches"],
-                    "occupancy_mean": stats["occupancy_mean"],
-                    "buckets": stats["buckets"],
-                },
+                "detail": detail,
             }
         )
     )
+
+
+def _bench_serve_fleet(variables, serve_bench) -> dict:
+    """Fleet rows (ISSUE 10): rps/p99/lost vs replica count through
+    tools/serve_fleet.py + real tools/serve.py replicas on the tiny
+    export, closed loop with a kill drill at 2 replicas. Each replica is
+    a full cold serve.py boot (jax import + ladder compile), so the rows
+    run only under the 300 s `--mode serve` child (MOCO_TPU_BENCH_FLEET
+    gates them). Failures degrade to an error field, never kill the
+    headline record."""
+    import tempfile
+
+    import jax
+
+    from moco_tpu.checkpoint import _save_flat, resnet_to_torchvision
+
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    try:
+        export = os.path.join(tmp, "tiny.npz")
+        flat = resnet_to_torchvision(
+            jax.tree.map(np.asarray, variables["params"]),
+            jax.tree.map(np.asarray, variables.get("batch_stats", {})),
+            prefix="module.encoder_q.",
+        )
+        _save_flat(flat, export)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        replica_cmd = [
+            sys.executable, os.path.join(repo, "tools", "serve.py"),
+            "--pretrained", export, "--arch", "resnet_tiny",
+            "--image-size", "32", "--cifar-stem", "true",
+            "--buckets", "1", "8", "32", "--flush-ms", "5.0",
+            "--max-queue", "128",
+        ]
+        env = dict(os.environ)
+        env.setdefault("MOCO_TPU_NO_CACHE", "1")  # throwaway replicas
+        rows = serve_bench.run_fleet_bench(
+            replica_cmd, counts=(1, 2), concurrency=32,
+            total_requests=256, image_size=32, pool=32, timeout_s=30.0,
+            kill_drill=True, kill_after_s=0.5, boot_timeout_s=120.0,
+            env=env,
+        )
+        return {"rows": rows,
+                "lost_total": sum(r.get("lost", 0) for r in rows)}
+    except Exception as e:  # the fleet rows are a bonus, never the record
+        return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 # wall-clock cap for the per-mode grad-sync sweep inside the step child

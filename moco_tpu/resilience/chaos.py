@@ -83,6 +83,20 @@ class ChaosPlan:
                                             # the rollback-exhaustion path)
     loader_error_at_batch: int | None = None  # Prefetcher read fault at batch b
     loader_error_count: int = 1             # consecutive faults before recovery
+    kill_at_request: int | None = None      # serve-side (ISSUE 10 fleet
+                                            # drills): self-SIGKILL after the
+                                            # k-th admitted request — a replica
+                                            # dying mid-load; only the fleet
+                                            # supervisor + router retry recover
+                                            # it
+    wedge_at_request: int | None = None     # serve-side: after the k-th
+                                            # admitted request, STOP answering
+                                            # (every later HTTP request —
+                                            # /healthz included — hangs on an
+                                            # accepted socket): the
+                                            # accepting-but-not-answering wedge
+                                            # the fleet's probe-staleness kill
+                                            # exists for
     state_dir: str | None = None            # fire-once markers persisted here
                                             # (supervised drills: faults fire
                                             # once ACROSS restarts, not once
@@ -155,6 +169,31 @@ class ChaosPlan:
             )
             time.sleep(self.slow_ms / 1e3)
 
+    def maybe_kill_request(self, n_requests: int) -> None:
+        """Serve-side SIGKILL after the n-th admitted request (fire-once,
+        marker-persisted: the fleet-restarted replica re-counts requests
+        from 0 and must not re-fire the drill into a crash loop)."""
+        if (self.kill_at_request == n_requests
+                and self._fire_once("kill_request")):
+            log_event("chaos", f"injecting SIGKILL at request {n_requests}")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_wedge_request(self, n_requests: int) -> bool:
+        """True once, at the n-th admitted request: the caller (the serve
+        front end) flips into accepting-but-not-answering — sockets still
+        accept, every handler thread then sleeps forever. Unlike the kill,
+        the wedge leaves a live process: only an outside probe-staleness
+        kill (the fleet supervisor's) ends it."""
+        if (self.wedge_at_request == n_requests
+                and self._fire_once("wedge_request")):
+            log_event(
+                "chaos",
+                f"injecting serve wedge (accepting-but-not-answering) at "
+                f"request {n_requests}",
+            )
+            return True
+        return False
+
     def maybe_nan(self, step: int) -> bool:
         """True at the configured step (the first `nan_count` traversals of
         it): the caller replaces the step's reported loss with NaN — the
@@ -200,6 +239,8 @@ _INT_FIELDS = (
     "nan_count",
     "loader_error_at_batch",
     "loader_error_count",
+    "kill_at_request",
+    "wedge_at_request",
 )
 
 

@@ -28,6 +28,10 @@ EXIT_SERVE_BIND = 47           # tools/serve.py could not bind its host:port
                                # (address in use / privileged port): restarting
                                # the same argv races the same socket — an
                                # orchestrator should reschedule, not retry-loop
+EXIT_FLEET_BIND = 48           # tools/serve_fleet.py could not bind the
+                               # FRONT-END router port (the replica ports are
+                               # the replicas' own 47s): same fatal semantics
+                               # — rescheduling beats racing the socket
 
 # argparse's own usage-error exit — not ours to raise, but the classifier
 # treats it like EXIT_CONFIG_ERROR (same argv can never succeed)
@@ -40,5 +44,6 @@ EXIT_CODE_NAMES: dict[int, str] = {
     EXIT_CONFIG_ERROR: "config_error",
     EXIT_DATA_QUALITY: "data_quality",
     EXIT_SERVE_BIND: "serve_bind",
+    EXIT_FLEET_BIND: "fleet_bind",
     USAGE_ERROR: "usage_error",
 }

@@ -49,6 +49,7 @@ from moco_tpu.resilience.exitcodes import (
     EXIT_CODE_NAMES,
     EXIT_CONFIG_ERROR,
     EXIT_DATA_QUALITY,
+    EXIT_FLEET_BIND,
     EXIT_OK,
     EXIT_PREEMPTED,
     EXIT_ROLLBACK_EXHAUSTED,
@@ -79,11 +80,13 @@ CLASS_OOM = "oom"                              # SIGKILL + high tail RSS
 CLASS_KILLED = "killed"                        # external SIGKILL/SIGTERM death
 CLASS_CRASH = "crash"                          # any other nonzero exit
 CLASS_SERVE_BIND = "serve_bind"                # serve.py couldn't bind its port
+CLASS_FLEET_BIND = "fleet_bind"                # serve_fleet.py couldn't bind
+                                               # its front-end router port
 
 # classes where restarting can never help — the run is OVER
 FATAL_CLASSES = frozenset({
     CLASS_CLEAN, CLASS_ROLLBACK_EXHAUSTED, CLASS_CONFIG_ERROR,
-    CLASS_DATA_QUALITY, CLASS_SERVE_BIND,
+    CLASS_DATA_QUALITY, CLASS_SERVE_BIND, CLASS_FLEET_BIND,
 })
 RESTARTABLE_CLASSES = frozenset({
     CLASS_PREEMPTED, CLASS_HANG, CLASS_NATIVE_CRASH, CLASS_OOM,
@@ -179,6 +182,7 @@ def classify_exit(
         # relaunching the same argv races the same occupied socket: the
         # orchestrator one level up must reschedule, not retry-loop
         EXIT_SERVE_BIND: CLASS_SERVE_BIND,
+        EXIT_FLEET_BIND: CLASS_FLEET_BIND,
         USAGE_ERROR: CLASS_CONFIG_ERROR,
     }
     if returncode in named:

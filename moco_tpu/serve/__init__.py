@@ -1,4 +1,5 @@
-"""moco_tpu.serve — online embedding service (ISSUE 5).
+"""moco_tpu.serve — online embedding service (ISSUE 5) + serve fleet
+(ISSUE 10).
 
 The repo's first non-training workload: a request-driven inference
 runtime over a pretraining checkpoint's momentum encoder. Layers:
@@ -10,39 +11,69 @@ runtime over a pretraining checkpoint's momentum encoder. Layers:
     cache.py     content-hash embedding LRU (byte-budgeted, the
                  data/canvas_cache.py pattern)
     service.py   the request path: validation → cache → batcher →
-                 engine (+ optional kNN classify), telemetry snapshots
+                 engine (+ optional kNN classify), hot weight reload,
+                 telemetry snapshots
     http.py      stdlib-HTTP front end (tools/serve.py mounts it)
+    fleet.py     replicated-serving control plane (ISSUE 10): fleet
+                 supervisor over N serve.py replicas, health-routed
+                 front-end router, checkpoint watcher with integrity-
+                 verified hot reload — PURE stdlib, never numpy/jax
 
-Train-free by lint (tools/lint_robustness.py R6): nothing here may
-import train, train_step, or optimizer modules — the server stays
-import-light and can never grow a training dependency by accident."""
+Train-free by lint (mocolint R6/R11): nothing here may import train,
+train_step, or optimizer modules — the server stays import-light and can
+never grow a training dependency by accident.
 
-from moco_tpu.serve.batcher import (
-    DeadlineExceededError,
-    DrainingError,
-    MicroBatcher,
-    OverloadedError,
-    PendingRequest,
-    RejectionError,
-    bucket_for,
-)
-from moco_tpu.serve.cache import EmbeddingCache
-from moco_tpu.serve.engine import DEFAULT_BUCKETS, EmbeddingEngine
-from moco_tpu.serve.http import ServeFrontend, decode_image
-from moco_tpu.serve.service import EmbedService
+This __init__ is LAZY (PEP 562, the telemetry/__init__ pattern): the
+fleet supervisor imports `moco_tpu.serve.fleet` — which executes this
+package body — and must stay importable without numpy or jax (the
+mocolint R11 fleet-stdlib-only boundary walks ancestor __init__s).
+Eagerly importing batcher/engine here would drag numpy into every fleet
+process; instead each public name resolves its submodule on first
+attribute access, so `from moco_tpu.serve import EmbedService` keeps
+working unchanged while `import moco_tpu.serve.fleet` touches nothing
+heavy."""
 
-__all__ = [
-    "DEFAULT_BUCKETS",
-    "DeadlineExceededError",
-    "DrainingError",
-    "EmbedService",
-    "EmbeddingCache",
-    "EmbeddingEngine",
-    "MicroBatcher",
-    "OverloadedError",
-    "PendingRequest",
-    "RejectionError",
-    "ServeFrontend",
-    "bucket_for",
-    "decode_image",
-]
+from __future__ import annotations
+
+import importlib
+
+# public name -> submodule that defines it
+_EXPORTS = {
+    "DeadlineExceededError": "batcher",
+    "DrainingError": "batcher",
+    "MicroBatcher": "batcher",
+    "OverloadedError": "batcher",
+    "PendingRequest": "batcher",
+    "RejectionError": "batcher",
+    "bucket_for": "batcher",
+    "EmbeddingCache": "cache",
+    "DEFAULT_BUCKETS": "engine",
+    "EmbeddingEngine": "engine",
+    "ServeFrontend": "http",
+    "decode_image": "http",
+    "EmbedService": "service",
+    "CheckpointWatcher": "fleet",
+    "FleetPolicy": "fleet",
+    "FleetRouter": "fleet",
+    "FleetSupervisor": "fleet",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        submodule = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    value = getattr(
+        importlib.import_module(f"{__name__}.{submodule}"), name
+    )
+    globals()[name] = value  # cache: later accesses skip __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

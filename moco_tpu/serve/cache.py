@@ -69,6 +69,14 @@ class EmbeddingCache:
             self._entries[key] = row
             self._bytes += cost
 
+    def clear(self) -> None:
+        """Drop every entry (hot weight reload: cached rows are functions
+        of the old weights). Hit/miss counters survive — they describe the
+        process's traffic, not one model version."""
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
     @property
     def entries(self) -> int:
         with self._lock:

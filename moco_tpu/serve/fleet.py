@@ -1,0 +1,1216 @@
+"""Replicated serving control plane (ISSUE 10 tentpole).
+
+PR 5 made one EmbedService process; one process is one SIGKILL away from
+a dead endpoint. This module composes the two most battle-tested
+subsystems in the repo — the PR 4 supervisor machinery and the PR 5
+serve stack — into a production-shaped fleet:
+
+  - `FleetSupervisor` spawns N `tools/serve.py` replicas on distinct
+    ports and supervises them the PR 4 way: per-replica `/healthz`
+    probes (a probe answer is the replica's heartbeat — staleness beyond
+    the window gets the SIGTERM → grace → SIGKILL escalation, exactly
+    the wedged-collective treatment), death classification through the
+    shared exit-code protocol (`supervisor.classify_exit`), and a
+    per-replica restart budget REFUNDED whenever the dead replica had
+    reached healthy in its last life — a crash-looping replica exhausts
+    its budget, a long-serving one restarts forever.
+  - `FleetRouter` (stdlib `ThreadingHTTPServer`) load-balances
+    `/v1/embed` and `/v1/knn` across in-rotation replicas by
+    least-outstanding, ejects a replica on any connection-level failure
+    (re-admission only through a later probe success), retries
+    connection-refused/reset EXACTLY once on a different replica under
+    the request's own deadline, and — when no healthy backend exists —
+    sheds with a structured 503 + retry hint. Every request ends in an
+    answer; the router never stalls and never silently drops.
+  - rolling restarts are DRAIN-AWARE and never take capacity below N−1:
+    one replica at a time, and only while every other active replica is
+    healthy — drain (router stops picking it, SIGTERM lets serve.py
+    finish in-flight work), relaunch, wait for the probe to readmit it,
+    then move on.
+  - `CheckpointWatcher` + the reload roll: a watch directory of exported
+    encoder steps (`<dir>/<step>/...` with PR 1 integrity manifests) is
+    polled; a step is deployed only once its manifest exists AND
+    verifies — corrupt/partial steps are QUARANTINED with the PR 4
+    preflight pattern (moved to `.quarantine/`, loudly, without crashing
+    anything). A verified step rolls across the fleet via each replica's
+    `POST /admin/reload`: the replica builds + warms the new engine
+    off-path and swaps atomically between micro-batches, so a live
+    pretrain run continuously deploys with zero dropped requests.
+    Replicas that were down during a roll converge on relaunch (the new
+    checkpoint is pinned into their argv) or on the next watcher pass.
+
+Every lifecycle transition lands as a `kind: "fleet"` record in the
+fleet's events.jsonl, stamped with the PR 8 run/trace ids the replicas
+inherit through their env — one merged story across router, supervisor
+and N serving processes.
+
+Pure stdlib by contract (mocolint R11 fleet-stdlib-only, transitive
+through moco_tpu modules): the routing tier must stay alive and tiny
+while replicas OOM, segfault, or poison their compile caches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import os
+import random
+import socket
+import subprocess
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from moco_tpu.resilience.integrity import manifest_path, verify_step
+from moco_tpu.resilience.supervisor import (
+    CLASS_CLEAN,
+    FATAL_CLASSES,
+    QUARANTINE_DIRNAME,
+    classify_exit,
+)
+from moco_tpu.telemetry.trace import Tracer
+from moco_tpu.utils.logging import log_event
+
+EVENTS_FILENAME = "events.jsonl"
+
+# payload suffixes the reload roll recognizes inside a watched step dir
+_EXPORT_SUFFIXES = (".safetensors", ".npz", ".bin")
+
+# structured error codes the router itself originates (the replica-side
+# codes — overloaded/deadline_exceeded/draining — pass through untouched)
+SHED_NO_BACKEND = "no_healthy_backend"
+SHED_UPSTREAM_TIMEOUT = "upstream_timeout"
+SHED_UPSTREAM_ERROR = "upstream_error"
+
+
+class FleetLaunchError(RuntimeError):
+    """A replica COMMAND could not be spawned at fleet start (missing
+    binary, exec failure). Distinct from the router's bind OSError on
+    purpose: the CLI maps the bind to EXIT_FLEET_BIND=48 (reschedule —
+    don't race the socket) and this to EXIT_CONFIG_ERROR=45 (the same
+    argv can never succeed)."""
+
+
+def pick_free_port(host: str = "127.0.0.1") -> int:
+    """Ephemeral-port discovery for auto replica ports (tests, bench).
+    Races are possible between close and the child's bind; a loser exits
+    EXIT_SERVE_BIND and the fleet classifies it fatal — loud, not flaky."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class FleetPolicy:
+    """Fleet supervision knobs (tools/serve_fleet.py exposes each)."""
+
+    probe_secs: float = 1.0            # per-replica /healthz cadence
+    probe_timeout_s: float = 2.0       # one probe's connect+answer budget
+    health_stale_secs: float = 10.0    # no probe ANSWER for this long
+                                       # (once healthy this life) -> the
+                                       # replica is wedged: kill it
+    startup_grace_secs: float = 300.0  # launch -> first healthy probe
+                                       # allowance (jax import + ladder
+                                       # compile on a cold replica)
+    term_grace_secs: float = 15.0      # SIGTERM -> grace -> SIGKILL
+    max_restarts: int = 5              # consecutive never-healthy deaths
+                                       # per replica before abandoning it;
+                                       # a healthy life refunds in full
+    backoff_base_secs: float = 0.5
+    backoff_max_secs: float = 30.0
+    backoff_jitter: float = 0.2
+    request_timeout_s: float = 30.0    # router default per-request
+                                       # deadline (body deadline_ms wins)
+    watch_poll_secs: float = 1.0       # checkpoint-watcher cadence
+    reload_timeout_s: float = 300.0    # one replica's /admin/reload budget
+                                       # (checkpoint load + full ladder
+                                       # warmup, off the request path)
+    stats_every_secs: float = 30.0     # router_stats event cadence
+
+    def backoff_secs(self, consecutive_failures: int,
+                     rng: random.Random) -> float:
+        base = min(
+            self.backoff_base_secs
+            * (2.0 ** max(consecutive_failures - 1, 0)),
+            self.backoff_max_secs,
+        )
+        return base * (1.0 + self.backoff_jitter * rng.random())
+
+
+class ReplicaState:
+    """One replica's supervision state. Every mutable field is guarded by
+    the fleet's lock; the router reads/writes `outstanding` under it."""
+
+    def __init__(self, index: int, host: str, port: int,
+                 telemetry_dir: str, budget: int):
+        self.index = index
+        self.host = host
+        self.port = port
+        self.telemetry_dir = telemetry_dir
+        self.proc: subprocess.Popen | None = None
+        self.pid: int | None = None
+        self.launches = 0
+        self.budget = budget
+        self.consecutive_failures = 0
+        self.healthy = False           # last probe answered 200 (rotation)
+        self.draining = False          # roll/stop took it out on purpose
+        self.abandoned = False         # fatal class or exhausted budget
+        self.expected_exit = False     # WE asked it to exit (roll, stop)
+        self.outstanding = 0           # router's in-flight count
+        self.launched_at = 0.0
+        self.last_ok_life: float | None = None  # newest probe ANSWER (200
+                                       # or draining-503) this life
+        self.ever_healthy_life = False
+        self.kill_phase: str | None = None      # None | "term" | "kill"
+        self.term_at = 0.0
+        self.relaunch_at: float | None = None   # pending relaunch time
+        self.deployed_step = -1        # newest hot-reloaded step
+        self.reload_announced = -1     # dedupe for reload_failed events
+        self.reload_refused_step = -1  # replica answered 409 for this
+                                       # step: a TERMINAL refusal (kNN
+                                       # bank, ladder change) — re-trying
+                                       # every pass would make the
+                                       # replica load+warm a checkpoint
+                                       # just to refuse it again; cleared
+                                       # on relaunch (fresh argv pins the
+                                       # payload)
+        self.classifications: list[str] = []
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def snapshot(self) -> dict:
+        return {
+            "replica": self.index,
+            "port": self.port,
+            "pid": self.pid,
+            "healthy": self.healthy,
+            "draining": self.draining,
+            "abandoned": self.abandoned,
+            "outstanding": self.outstanding,
+            "launches": self.launches,
+            "restarts": max(self.launches - 1, 0),
+            "budget_left": self.budget,
+            "deployed_step": self.deployed_step,
+            "classifications": list(self.classifications),
+        }
+
+
+# ---------------------------------------------------------------------------
+# the front-end router
+# ---------------------------------------------------------------------------
+
+
+class _RouterServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # same reasoning as serve/http.py: a backlog of 5 resets reconnecting
+    # closed-loop clients; the structured shed is the admission control
+    request_queue_size = 128
+
+
+def _make_router_handler(fleet: "FleetSupervisor"):
+    policy = fleet.policy
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass  # per-request stderr drowns the structured channel
+
+        def _send(self, status: int, obj: dict) -> None:
+            body = json.dumps(obj).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_raw(self, status: int, body: bytes) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                healthy = fleet.healthy_count()
+                body = {
+                    "status": "ok" if healthy else "no_healthy_backend",
+                    "healthy": healthy,
+                    "replicas": len(fleet.replicas),
+                }
+                self._send(200 if healthy else 503, body)
+            elif self.path == "/stats":
+                self._send(200, fleet.stats())
+            else:
+                self._send(404, {"error": "not_found", "path": self.path})
+
+        def do_POST(self):
+            # /admin/* is deliberately NOT proxied: reload/ops surface
+            # stays on the replicas' own ports, reachable only by the
+            # fleet supervisor (or an operator), never by public traffic
+            if self.path not in ("/v1/embed", "/v1/knn"):
+                self.rfile.read(int(self.headers.get("Content-Length") or 0))
+                self._send(404, {"error": "not_found", "path": self.path})
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length)
+            status, out = fleet.router_proxy(self.path, body)
+            self._send_raw(status, out)
+
+    return Handler
+
+
+class FleetRouter:
+    """Owns the front-end `ThreadingHTTPServer`; the routing logic itself
+    lives on the fleet (it needs the replica table). `port=0` binds an
+    ephemeral port exposed as `.port`."""
+
+    def __init__(self, fleet: "FleetSupervisor", host: str, port: int):
+        self.server = _RouterServer((host, port),
+                                    _make_router_handler(fleet))
+        self.host, self.port = self.server.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True,
+            name="fleet-router",
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        if self._thread is not None:
+            # BaseServer.shutdown() BLOCKS until serve_forever acks —
+            # calling it on a bound-but-never-started server (the
+            # partial-start cleanup path) would hang forever
+            self.server.shutdown()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint watcher (unit-testable standalone; the fleet runs it in a
+# thread and rolls what it finds)
+# ---------------------------------------------------------------------------
+
+
+class CheckpointWatcher:
+    """Poll a directory of exported encoder steps (`<dir>/<step>/<file>`
+    + `.integrity/<step>.json` manifests, the PR 1 layout) for new
+    deployable checkpoints.
+
+    Deployment gate, in order: a step WITHOUT a manifest is skipped
+    silently (the exporter writes the manifest last, atomically — its
+    absence means the step is still being written); a step whose
+    manifest FAILS verification is quarantined to `.quarantine/<step>`
+    with the PR 4 preflight pattern and never considered again; the
+    NEWEST verifying step wins (older not-yet-deployed steps are
+    skipped — serving wants the freshest weights, not a replay).
+    `poll_once()` returns `(step, payload_path)` for a newly deployable
+    step, else None."""
+
+    def __init__(self, watch_dir: str, *, floor: int = -1, emit=None):
+        self.watch_dir = watch_dir
+        self.floor = floor          # newest step already seen/deployed
+        self._emit = emit or (lambda event, **fields: None)
+        self._bad_layout: set[int] = set()
+
+    def poll_once(self) -> tuple[int, str] | None:
+        try:
+            names = os.listdir(self.watch_dir)
+        except OSError:
+            return None  # watch dir not created yet
+        steps = sorted((int(n) for n in names if n.isdigit()), reverse=True)
+        for step in steps:
+            if step <= self.floor:
+                break  # newest-first: everything older is already decided
+            if step in self._bad_layout:
+                continue
+            if not os.path.exists(manifest_path(self.watch_dir, step)):
+                continue  # still being exported: manifest lands last
+            reason = verify_step(self.watch_dir, step)
+            if reason is not None:
+                self._quarantine(step, reason)
+                continue
+            payload = self._payload(step)
+            if payload is None:
+                self._bad_layout.add(step)
+                self._emit("reload_bad_layout", step=step,
+                           detail="no single export payload in step dir")
+                continue
+            self.floor = step
+            return step, payload
+        return None
+
+    def _payload(self, step: int) -> str | None:
+        step_dir = os.path.join(self.watch_dir, str(step))
+        try:
+            files = sorted(
+                f for f in os.listdir(step_dir)
+                if os.path.isfile(os.path.join(step_dir, f))
+            )
+        except OSError:
+            return None
+        known = [f for f in files if f.endswith(_EXPORT_SUFFIXES)]
+        chosen = known[0] if known else (files[0] if len(files) == 1 else None)
+        return os.path.join(step_dir, chosen) if chosen else None
+
+    def _quarantine(self, step: int, reason: str) -> None:
+        qdir = os.path.join(self.watch_dir, QUARANTINE_DIRNAME)
+        os.makedirs(qdir, exist_ok=True)
+        target = os.path.join(qdir, str(step))
+        if os.path.exists(target):
+            target = f"{target}.{int(time.time())}"
+        os.rename(os.path.join(self.watch_dir, str(step)), target)
+        try:
+            os.remove(manifest_path(self.watch_dir, step))
+        except OSError:
+            pass
+        self._emit("reload_quarantine", step=step, reason=reason,
+                   moved_to=target)
+        log_event(
+            "fleet",
+            f"quarantined corrupt checkpoint step {step} ({reason}) "
+            f"-> {target}; the fleet keeps serving the previous weights",
+        )
+
+    def run(self, poll_secs: float, stop: threading.Event, on_new) -> None:
+        """Thread body: poll until `stop`; `on_new(step, path)` for each
+        newly deployable step (the fleet's reload roll). A filesystem
+        error mid-poll (unwritable quarantine dir, a file vanishing
+        between stat and hash) must not kill the watcher thread — that
+        would silently disable hot reload for the fleet's lifetime
+        while everything reports healthy. Errors are emitted and the
+        next poll retries."""
+        while not stop.is_set():
+            try:
+                found = self.poll_once()
+                if found is not None:
+                    on_new(*found)
+            except OSError as e:
+                self._emit("reload_watch_error",
+                           detail=f"{type(e).__name__}: {e}")
+                log_event("fleet",
+                          f"checkpoint watcher error (will retry): {e}")
+            stop.wait(poll_secs)
+
+
+# ---------------------------------------------------------------------------
+# the fleet supervisor
+# ---------------------------------------------------------------------------
+
+
+class FleetSupervisor:
+    """Supervise N serve replicas behind one router.
+
+    `child_argv(index, port, telemetry_dir, pretrained)` builds one
+    replica's command (tools/serve_fleet.py appends `--port`/
+    `--telemetry-dir` — and, after a hot reload, `--pretrained` — to the
+    operator's base command; tests point it at stub scripts).
+    `pretrained` is None until a watcher deployment happens, then the
+    deployed payload path — a replica relaunched after a reload roll
+    must come back with the NEW weights, not the boot-time ones."""
+
+    def __init__(
+        self,
+        child_argv,
+        *,
+        replicas: int,
+        telemetry_dir: str,
+        host: str = "127.0.0.1",
+        router_port: int = 0,
+        base_port: int = 0,
+        policy: FleetPolicy | None = None,
+        watch_dir: str = "",
+        env: dict | None = None,
+        replica_env: dict | None = None,
+        seed: int | None = None,
+    ):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self._child_argv = child_argv
+        self.n_replicas = int(replicas)
+        self.telemetry_dir = telemetry_dir
+        self.host = host
+        self._router_port = router_port
+        self._base_port = base_port
+        self.policy = policy or FleetPolicy()
+        self.watch_dir = watch_dir
+        self._env = env
+        self._replica_env = dict(replica_env or {})
+        self._rng = random.Random(seed)  # None -> system entropy (PR 4
+                                         # lesson: no fleet-wide lockstep)
+        self.events_path = os.path.join(telemetry_dir, EVENTS_FILENAME)
+        self.incidents: list[dict] = []
+        # ONE run id for router + supervisor + every replica (PR 8):
+        # replicas inherit it via env, so their serve snapshots and the
+        # fleet's lifecycle records merge into one timeline
+        self.tracer = Tracer(telemetry_dir, "steps", proc="fleet")
+        self.run_id = self.tracer.run_id
+        self._lock = threading.Lock()
+        self._emit_lock = threading.Lock()
+        self.replicas: list[ReplicaState] = []
+        self.router: FleetRouter | None = None
+        self.failed = False            # every replica abandoned
+        self._stop = threading.Event()
+        self._monitor_thread: threading.Thread | None = None
+        self._watch_thread: threading.Thread | None = None
+        self._watcher: CheckpointWatcher | None = None
+        self._roll: dict | None = None
+        self._roll_requested = False
+        self._target_step = -1
+        self._target_path: str | None = None
+        self._announced_step = -1
+        # the roll runs from the watcher thread (new step) AND the
+        # monitor thread (a recovered replica converging): serialize so
+        # one replica never sees two concurrent /admin/reload POSTs
+        self._reload_roll_lock = threading.Lock()
+        self._current_pretrained: str | None = None
+        self._last_shed_event = float("-inf")
+        self._last_stats_event = 0.0
+        # router counters (guarded by _lock)
+        self.r_requests = 0
+        self.r_ok = 0
+        self.r_retries = 0
+        self.r_retry_ok = 0
+        self.r_shed_no_backend = 0
+        self.r_upstream_timeout = 0
+        self.r_upstream_error = 0
+        self.r_passthrough_error = 0   # replica answered non-200 (its own
+                                       # structured shed: counted, passed)
+
+    # -- structured events ---------------------------------------------------
+    def _emit(self, event: str, **fields) -> None:
+        record = {"v": 1, "t": round(time.time(), 3), "kind": "fleet",
+                  "event": event, "run_id": self.run_id,
+                  "trace_id": self.tracer.trace_id}
+        record.update(fields)
+        os.makedirs(self.telemetry_dir, exist_ok=True)
+        with self._emit_lock:
+            self.incidents.append(record)
+            with open(self.events_path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(record) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        detail = " ".join(f"{k}={v}" for k, v in fields.items())
+        log_event("fleet", f"{event} {detail}".strip())
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        """Bind the router (its OSError propagates raw — the CLI maps it
+        to EXIT_FLEET_BIND), launch every replica, start the monitor
+        (and the checkpoint watcher when configured). Every OTHER
+        OSError (unwritable telemetry dir, un-spawnable replica command)
+        is re-raised as FleetLaunchError: 48 means 'reschedule me' and a
+        filesystem/argv problem rescheduled is an infinite loop."""
+        try:
+            os.makedirs(self.telemetry_dir, exist_ok=True)
+        except OSError as e:
+            raise FleetLaunchError(
+                f"cannot create telemetry dir {self.telemetry_dir!r}: {e}"
+            ) from e
+        self.router = FleetRouter(self, self.host, self._router_port)
+        try:
+            ports = []
+            for i in range(self.n_replicas):
+                port = (self._base_port + i if self._base_port
+                        else pick_free_port(self.host))
+                ports.append(port)
+                rdir = os.path.join(self.telemetry_dir, f"replica{i}")
+                os.makedirs(rdir, exist_ok=True)
+                self.replicas.append(
+                    ReplicaState(i, self.host, port, rdir,
+                                 self.policy.max_restarts)
+                )
+            self._emit("fleet_start", replicas=self.n_replicas,
+                       ports=ports, router=self.router.url,
+                       watch_dir=self.watch_dir or None)
+            for r in self.replicas:
+                self._launch(r)
+        except OSError as e:
+            # a replica COMMAND that cannot spawn (FileNotFoundError,
+            # EMFILE...) or a replica dir/log that cannot be written:
+            # kill whatever did launch and release the router — a
+            # partial start must not leak processes — and re-raise as a
+            # non-OSError so the CLI can't mistake it for a bind failure
+            for r in self.replicas:
+                if r.alive():
+                    r.proc.kill()
+                    r.proc.wait()
+            self.router.shutdown()
+            raise FleetLaunchError(
+                f"cannot start the fleet: {e}"
+            ) from e
+        self.router.start()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="fleet-monitor"
+        )
+        self._monitor_thread.start()
+        if self.watch_dir:
+            self._watcher = CheckpointWatcher(self.watch_dir,
+                                              emit=self._emit)
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, daemon=True, name="fleet-watcher"
+            )
+            self._watch_thread.start()
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        """Drain-stop: SIGTERM every replica (serve.py finishes accepted
+        work), wait, escalate stragglers, then stop the router."""
+        with self._lock:
+            already = self._stop.is_set()
+        if already:
+            return
+        self._emit("fleet_stop_begin", healthy=self.healthy_count())
+        self._stop.set()
+        for t in (self._monitor_thread, self._watch_thread):
+            if t is not None:
+                t.join(timeout=max(self.policy.probe_timeout_s * 2, 5.0))
+        for r in self.replicas:
+            with self._lock:
+                r.draining = True
+                r.expected_exit = True
+            if r.alive():
+                r.proc.terminate()
+        deadline = time.monotonic() + timeout_s
+        for r in self.replicas:
+            if r.proc is None:
+                continue
+            while r.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if r.proc.poll() is None:
+                r.proc.kill()
+                r.proc.wait()
+        self._emit_router_stats(final=True)
+        self._emit("fleet_stop",
+                   launches=sum(r.launches for r in self.replicas))
+        if self.router is not None:
+            self.router.shutdown()
+        self.tracer.close()
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for r in self.replicas
+                if r.healthy and not r.draining and not r.abandoned
+            )
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "run_id": self.run_id,
+                "router": self._router_counters(),
+                "replicas": [r.snapshot() for r in self.replicas],
+                "target_step": self._target_step,
+                "rolling_restart": self._roll is not None,
+            }
+
+    def _router_counters(self) -> dict:
+        # caller holds the lock
+        return {
+            "requests": self.r_requests,
+            "ok": self.r_ok,
+            "retries": self.r_retries,
+            "retry_ok": self.r_retry_ok,
+            "shed_no_backend": self.r_shed_no_backend,
+            "upstream_timeout": self.r_upstream_timeout,
+            "upstream_error": self.r_upstream_error,
+            "passthrough_non_200": self.r_passthrough_error,
+        }
+
+    # -- routing (called from router handler threads) ------------------------
+    def pick_backend(self, exclude=()) -> ReplicaState | None:
+        with self._lock:
+            cands = [
+                r for r in self.replicas
+                if r.healthy and not r.draining and not r.abandoned
+                and r.proc is not None and r.index not in exclude
+            ]
+            if not cands:
+                return None
+            r = min(cands, key=lambda c: (c.outstanding, c.index))
+            r.outstanding += 1
+            return r
+
+    def release_backend(self, r: ReplicaState) -> None:
+        with self._lock:
+            r.outstanding = max(r.outstanding - 1, 0)
+
+    def eject(self, r: ReplicaState, reason: str) -> None:
+        """Take a replica out of rotation NOW (router-observed failure or
+        probe failure). Re-admission only through a later probe success —
+        one bad connect must not flap it back in by itself."""
+        with self._lock:
+            was = r.healthy
+            r.healthy = False
+        if was:
+            self._emit("eject", replica=r.index, reason=reason)
+
+    def router_proxy(self, path: str, body: bytes) -> tuple[int, bytes]:
+        """One client request: pick → forward → (maybe) retry once on a
+        DIFFERENT replica → answer. Returns (status, response bytes)."""
+        with self._lock:
+            self.r_requests += 1
+        deadline = time.monotonic() + self._deadline_s(body)
+        tried: list[int] = []
+        last_err = "?"
+        for attempt in (0, 1):
+            replica = self.pick_backend(exclude=tried)
+            if replica is None:
+                return self._shed_no_backend()
+            tried.append(replica.index)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0.01:
+                return 504, json.dumps({
+                    "error": "deadline_exceeded",
+                    "detail": "request deadline elapsed at the router",
+                }).encode()
+            try:
+                status, data = self._forward(replica, path, body, remaining)
+            except (ConnectionError, http.client.HTTPException) as e:
+                # the replica died under us (refused / reset / torn
+                # response). Embeddings are pure functions of the input,
+                # so a replay is safe — retry ONCE on a different replica
+                self.eject(replica, f"connect:{type(e).__name__}")
+                last_err = f"{type(e).__name__}: {e}"
+                with self._lock:
+                    self.r_retries += 1
+                continue
+            except (TimeoutError, OSError) as e:
+                # a timeout consumed the request's own deadline: answer
+                # structured, eject (the probe readmits a merely-slow
+                # replica on its next success), do NOT replay
+                self.eject(replica, f"timeout:{type(e).__name__}")
+                with self._lock:
+                    self.r_upstream_timeout += 1
+                return 504, json.dumps({
+                    "error": SHED_UPSTREAM_TIMEOUT,
+                    "replica": replica.index,
+                    "detail": f"{type(e).__name__}: {e}",
+                }).encode()
+            finally:
+                self.release_backend(replica)
+            with self._lock:
+                if status == 200:
+                    self.r_ok += 1
+                    if attempt:
+                        self.r_retry_ok += 1
+                else:
+                    self.r_passthrough_error += 1
+            return status, data
+        with self._lock:
+            self.r_upstream_error += 1
+        return 502, json.dumps({
+            "error": SHED_UPSTREAM_ERROR,
+            "detail": f"both attempts failed; last: {last_err}",
+            "retry_after_ms": round(self.policy.probe_secs * 1e3, 1),
+        }).encode()
+
+    def _forward(self, r: ReplicaState, path: str, body: bytes,
+                 timeout_s: float) -> tuple[int, bytes]:
+        """One attempt against one replica. A FRESH connection per
+        attempt: a dead replica then fails at connect() — a clean,
+        immediately-retryable signal — instead of a half-dead pooled
+        socket ambiguously timing out."""
+        conn = http.client.HTTPConnection(r.host, r.port,
+                                          timeout=max(timeout_s, 0.01))
+        try:
+            conn.request("POST", path, body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def _deadline_s(self, body: bytes) -> float:
+        """The request's own deadline_ms when present, else the router
+        default. The substring pre-check keeps the common no-deadline
+        path from paying a JSON parse of a ~200 KB image body."""
+        if b'"deadline_ms"' in body:
+            try:
+                v = json.loads(body).get("deadline_ms")
+                if v:
+                    return min(max(float(v) / 1e3, 0.05), 600.0)
+            except (ValueError, json.JSONDecodeError):
+                pass  # malformed body: the replica answers 400 either way
+        return self.policy.request_timeout_s
+
+    def _shed_no_backend(self) -> tuple[int, bytes]:
+        now = time.monotonic()
+        emit = False
+        with self._lock:
+            self.r_shed_no_backend += 1
+            if now - self._last_shed_event > 5.0:  # rate-limited event
+                self._last_shed_event = now
+                emit = True
+        if emit:
+            self._emit("no_backend", healthy=0,
+                       sheds=self.r_shed_no_backend)
+        retry_ms = round(
+            max(self.policy.probe_secs, self.policy.backoff_base_secs)
+            * 1e3, 1,
+        )
+        return 503, json.dumps({
+            "error": SHED_NO_BACKEND,
+            "retry_after_ms": retry_ms,
+        }).encode()
+
+    # -- replica lifecycle ---------------------------------------------------
+    def _launch(self, r: ReplicaState) -> None:
+        with self._lock:
+            pretrained = self._current_pretrained
+            target = self._target_step
+        argv = self._child_argv(r.index, r.port, r.telemetry_dir,
+                                pretrained)
+        env = dict(os.environ if self._env is None else self._env)
+        env.update(self.tracer.child_env())
+        env.update(self._replica_env.get(r.index, {}))
+        log_file = open(os.path.join(r.telemetry_dir, "child.log"), "ab")
+        try:
+            proc = subprocess.Popen(argv, stdout=log_file,
+                                    stderr=subprocess.STDOUT, env=env)
+        finally:
+            log_file.close()  # the child holds its own descriptor
+        now = time.monotonic()
+        with self._lock:
+            r.proc = proc
+            r.pid = proc.pid
+            r.launches += 1
+            r.launched_at = now
+            r.last_ok_life = None
+            r.ever_healthy_life = False
+            r.healthy = False
+            r.kill_phase = None
+            r.relaunch_at = None
+            r.expected_exit = False
+            # a relaunch boots on the newest deployed checkpoint (pinned
+            # into argv above): it converges without a reload roll
+            r.deployed_step = target
+            r.reload_refused_step = -1
+        self._emit("launch", replica=r.index, attempt=r.launches - 1,
+                   pid=proc.pid, port=r.port, budget_left=r.budget,
+                   pretrained=pretrained)
+
+    def _try_launch(self, r: ReplicaState) -> bool:
+        """RE-launch path (monitor loop, roll machine): a spawn failure
+        here — the binary vanished mid-run, fd exhaustion — must abandon
+        the replica loudly, never unwind the monitor thread."""
+        try:
+            self._launch(r)
+            return True
+        except OSError as e:
+            with self._lock:
+                r.abandoned = True
+            self._emit("give_up", replica=r.index,
+                       reason=f"relaunch failed to spawn: {e}")
+            self._check_all_abandoned()
+            return False
+
+    def _handle_exit(self, r: ReplicaState) -> None:
+        rc = r.proc.returncode
+        hang = r.kill_phase is not None
+        cls, detail = classify_exit(rc, hang_killed=hang)
+        now = time.monotonic()
+        with self._lock:
+            expected = r.expected_exit
+            progressed = r.ever_healthy_life
+            pid = r.pid
+            r.proc = None
+            r.healthy = False
+            r.kill_phase = None
+            r.expected_exit = False
+            r.classifications.append(cls)
+        self._emit("replica_exit", replica=r.index, pid=pid, returncode=rc,
+                   classification=cls, detail=detail,
+                   progressed=progressed, expected=expected)
+        if expected:
+            return  # the roll machine (or stop()) owns the relaunch
+        if cls in FATAL_CLASSES and cls != CLASS_CLEAN:
+            # CLEAN is fatal for a RUN supervisor (the run is over); a
+            # serve fleet wants N replicas — an unexpected clean exit
+            # (someone SIGTERM'd a replica) restarts like any death
+            with self._lock:
+                r.abandoned = True
+            self._emit("give_up", replica=r.index,
+                       reason=f"fatal class {cls}", returncode=rc)
+            self._check_all_abandoned()
+            return
+        delay = 0.0
+        with self._lock:
+            if progressed:
+                r.budget = self.policy.max_restarts
+                r.consecutive_failures = 0
+            else:
+                r.consecutive_failures += 1
+                if r.budget <= 0:
+                    r.abandoned = True
+                else:
+                    r.budget -= 1
+                    delay = self.policy.backoff_secs(
+                        r.consecutive_failures, self._rng
+                    )
+            abandoned = r.abandoned
+            if not abandoned:
+                r.relaunch_at = now + delay
+        if abandoned:
+            self._emit(
+                "give_up", replica=r.index,
+                reason=(f"restart budget exhausted: "
+                        f"{r.consecutive_failures} consecutive "
+                        f"never-healthy deaths "
+                        f"(max_restarts={self.policy.max_restarts})"),
+            )
+            self._check_all_abandoned()
+        elif delay:
+            self._emit("backoff", replica=r.index, secs=round(delay, 3),
+                       consecutive_failures=r.consecutive_failures,
+                       budget_left=r.budget)
+
+    def _check_all_abandoned(self) -> None:
+        with self._lock:
+            dead = all(r.abandoned for r in self.replicas)
+            self.failed = dead
+        if dead:
+            self._emit("fleet_give_up",
+                       reason="every replica is abandoned")
+
+    # -- probing -------------------------------------------------------------
+    def _probe(self, r: ReplicaState) -> str:
+        """GET /healthz with the probe budget; returns "ok", "draining",
+        or an error string."""
+        conn = http.client.HTTPConnection(
+            r.host, r.port, timeout=self.policy.probe_timeout_s
+        )
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status == 200:
+                return "ok"
+            if resp.status == 503:
+                return "draining"
+            return f"status {resp.status}"
+        except (OSError, http.client.HTTPException) as e:
+            return f"{type(e).__name__}: {e}"
+        finally:
+            conn.close()
+
+    def _probe_and_update(self, r: ReplicaState) -> None:
+        result = self._probe(r)
+        now = time.monotonic()
+        event = None
+        if result == "ok":
+            with self._lock:
+                r.last_ok_life = now
+                if not r.healthy and not r.draining:
+                    event = ("readmit" if r.ever_healthy_life
+                             else "replica_healthy")
+                    r.healthy = True
+                    r.ever_healthy_life = True
+            if event:
+                self._emit(event, replica=r.index, pid=r.pid)
+        elif result == "draining":
+            # alive (an answer IS a heartbeat) but not routable
+            with self._lock:
+                r.last_ok_life = now
+                was = r.healthy
+                r.healthy = False
+            if was:
+                self._emit("eject", replica=r.index, reason="draining")
+        else:
+            self.eject(r, f"probe:{result}")
+
+    def _check_staleness(self, r: ReplicaState, now: float) -> None:
+        """The wedge killer: a replica whose socket accepts but whose
+        handler never answers (or whose process is silently stuck) gets
+        the SIGTERM → grace → SIGKILL escalation once its last probe
+        ANSWER is older than the window."""
+        if r.expected_exit or not r.alive():
+            return
+        if r.kill_phase == "term":
+            if now - r.term_at > self.policy.term_grace_secs:
+                self._emit("kill", replica=r.index, pid=r.pid,
+                           reason="probe_stale", phase="sigkill")
+                r.proc.kill()
+                with self._lock:
+                    r.kill_phase = "kill"
+            return
+        if r.kill_phase is not None:
+            return
+        ref = r.last_ok_life if r.last_ok_life is not None else r.launched_at
+        window = (self.policy.health_stale_secs if r.last_ok_life is not None
+                  else self.policy.startup_grace_secs)
+        stale_for = now - ref
+        if stale_for > window:
+            self._emit("kill", replica=r.index, pid=r.pid,
+                       reason="probe_stale",
+                       stale_secs=round(stale_for, 3), phase="sigterm")
+            r.proc.terminate()
+            with self._lock:
+                r.kill_phase = "term"
+                r.term_at = now
+
+    # -- rolling restart -----------------------------------------------------
+    def request_rolling_restart(self) -> None:
+        with self._lock:
+            self._roll_requested = True
+        self._emit("roll_requested")
+
+    def rolling_restart(self, timeout_s: float = 120.0) -> bool:
+        """Blocking convenience (tests, SIGHUP handler thread): request a
+        roll and wait for it to finish. True when the roll completed."""
+        self.request_rolling_restart()
+        deadline = time.monotonic() + timeout_s
+        started = False
+        while time.monotonic() < deadline:
+            with self._lock:
+                rolling = self._roll is not None or self._roll_requested
+            if rolling:
+                started = True
+            elif started:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def _advance_roll(self, now: float) -> None:
+        with self._lock:
+            if self._roll is None:
+                if not self._roll_requested:
+                    return
+                self._roll_requested = False
+                queue = [r.index for r in self.replicas if not r.abandoned]
+                if not queue:
+                    return
+                self._roll = {"queue": queue, "idx": None,
+                              "phase": "await", "t": now}
+                begin = True
+            else:
+                begin = False
+            roll = self._roll
+        if begin:
+            self._emit("roll_begin", replicas=roll["queue"])
+        if roll["idx"] is None:
+            if not roll["queue"]:
+                with self._lock:
+                    self._roll = None
+                self._emit("roll_end")
+                return
+            idx = roll["queue"][0]
+            r = self.replicas[idx]
+            if r.abandoned:
+                # abandoned since roll-begin: it will never come alive —
+                # skip it, or the roll (and every future roll) wedges
+                # waiting on a replica nobody will relaunch
+                with self._lock:
+                    roll["queue"].pop(0)
+                self._emit("roll_replica", replica=idx, phase="skipped",
+                           reason="abandoned")
+                return
+            # capacity guard (never below N−1): take the next replica out
+            # only while every OTHER active replica is in rotation
+            with self._lock:
+                others_ok = all(
+                    c.healthy for c in self.replicas
+                    if c.index != idx and not c.abandoned
+                )
+            if not others_ok or not r.alive():
+                return  # wait for the fleet to be whole first
+            with self._lock:
+                roll["queue"].pop(0)
+                roll["idx"] = idx
+                roll["phase"] = "wait_exit"
+                roll["t"] = now
+                r.draining = True      # router stops picking it NOW
+                r.expected_exit = True
+            self._emit("roll_replica", replica=idx, phase="drain")
+            r.proc.terminate()         # serve.py drains + exits EXIT_OK
+            return
+        r = self.replicas[roll["idx"]]
+        if roll["phase"] == "wait_exit":
+            if r.proc is None:         # _handle_exit consumed the death
+                with self._lock:
+                    r.draining = False
+                if not self._try_launch(r):
+                    self._emit("roll_abort", replica=r.index,
+                               reason="relaunch failed to spawn")
+                    with self._lock:
+                        self._roll = None
+                    return
+                with self._lock:
+                    roll["phase"] = "wait_healthy"
+                    roll["t"] = now
+            elif (now - roll["t"] > self.policy.term_grace_secs
+                    and r.alive()):
+                self._emit("roll_replica", replica=r.index,
+                           phase="sigkill")
+                r.proc.kill()
+        elif roll["phase"] == "wait_healthy":
+            if r.healthy:
+                self._emit("roll_replica", replica=r.index, phase="done")
+                with self._lock:
+                    roll["idx"] = None
+            elif now - roll["t"] > self.policy.startup_grace_secs:
+                # the relaunch never came up: abort the roll (capacity is
+                # already degraded; the normal restart policy owns the
+                # sick replica from here)
+                self._emit("roll_abort", replica=r.index,
+                           reason="relaunch never became healthy")
+                with self._lock:
+                    self._roll = None
+
+    # -- hot reload ----------------------------------------------------------
+    def _watch_loop(self) -> None:
+        self._watcher.run(
+            self.policy.watch_poll_secs, self._stop, self._on_new_step
+        )
+
+    def _on_new_step(self, step: int, path: str) -> None:
+        with self._lock:
+            self._target_step = step
+            self._target_path = path
+            self._current_pretrained = path
+        self._emit("reload_detected", step=step, path=path)
+        self._reload_sync()
+
+    def _reload_sync(self) -> None:
+        """Bring every in-rotation replica to the target step, one at a
+        time (the reload happens OFF the replica's request path, so
+        capacity never drops during the roll). Replicas that are down or
+        unhealthy converge later: on relaunch (argv pins the new
+        payload) or on the next watcher pass."""
+        if not self._reload_roll_lock.acquire(blocking=False):
+            return  # a roll is in flight; the next pass converges
+        try:
+            self._reload_sync_locked()
+        finally:
+            self._reload_roll_lock.release()
+
+    def _reload_sync_locked(self) -> None:
+        with self._lock:
+            step, path = self._target_step, self._target_path
+        if path is None:
+            return
+        for r in list(self.replicas):
+            if self._stop.is_set():
+                return
+            with self._lock:
+                skip = (r.abandoned or not r.healthy
+                        or r.deployed_step >= step
+                        or r.reload_refused_step >= step)
+            if skip:
+                continue
+            ok, detail = self._post_reload(r, step, path)
+            if ok:
+                with self._lock:
+                    r.deployed_step = step
+                self._emit("reload_replica", replica=r.index, step=step,
+                           status="ok", detail=detail)
+            else:
+                with self._lock:
+                    announce = r.reload_announced != step
+                    r.reload_announced = step
+                    if detail.startswith("status 409"):
+                        # 409 is reload_refused ONLY (kNN bank, ladder
+                        # change — http.py maps transient load failures
+                        # to 503): terminal for this step, stop
+                        # re-attempting; transient failures retry on the
+                        # next pass
+                        r.reload_refused_step = step
+                if announce:
+                    self._emit("reload_failed", replica=r.index,
+                               step=step, detail=detail)
+        with self._lock:
+            done = all(
+                r.deployed_step >= step
+                for r in self.replicas if not r.abandoned
+            ) and self._announced_step < step
+            if done:
+                self._announced_step = step
+        if done:
+            self._emit("reload_done", step=step, path=path,
+                       replicas=self.n_replicas)
+
+    def _post_reload(self, r: ReplicaState, step: int,
+                     path: str) -> tuple[bool, str]:
+        body = json.dumps({"pretrained": path, "step": step}).encode()
+        conn = http.client.HTTPConnection(
+            r.host, r.port, timeout=self.policy.reload_timeout_s
+        )
+        try:
+            conn.request("POST", "/admin/reload", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status == 200:
+                return True, data.decode("utf-8", errors="replace")[:200]
+            return False, (f"status {resp.status}: "
+                           + data.decode("utf-8", errors="replace")[:200])
+        except (OSError, http.client.HTTPException) as e:
+            return False, f"{type(e).__name__}: {e}"
+        finally:
+            conn.close()
+
+    # -- the monitor loop ----------------------------------------------------
+    def _monitor_loop(self) -> None:
+        poll = max(min(self.policy.probe_secs / 2.0, 0.5), 0.02)
+        while not self._stop.is_set():
+            now = time.monotonic()
+            for r in self.replicas:
+                if r.abandoned:
+                    continue
+                if r.proc is None:
+                    with self._lock:
+                        due = (r.relaunch_at is not None
+                               and now >= r.relaunch_at)
+                    if due:
+                        self._try_launch(r)
+                    continue
+                if r.proc.poll() is not None:
+                    self._handle_exit(r)
+                    continue
+                if now - getattr(r, "_last_probe", 0.0) \
+                        >= self.policy.probe_secs:
+                    r._last_probe = now
+                    self._probe_and_update(r)
+                self._check_staleness(r, now)
+            self._advance_roll(time.monotonic())
+            # a reload target may predate a replica's recovery: converge
+            # — on a THROWAWAY thread, never this one: one reload blocks
+            # for a checkpoint load + ladder warmup, and the monitor
+            # must keep probing/killing/relaunching the OTHER replicas
+            # meanwhile (_reload_sync itself no-ops when a roll is
+            # already in flight, so the spawn is cheap and un-duplicated)
+            with self._lock:
+                need_sync = any(
+                    not r.abandoned and r.healthy
+                    and r.deployed_step < self._target_step
+                    and r.reload_refused_step < self._target_step
+                    for r in self.replicas
+                ) if self._target_path else False
+            if need_sync and not self._reload_roll_lock.locked():
+                threading.Thread(target=self._reload_sync, daemon=True,
+                                 name="fleet-reload-converge").start()
+            now = time.monotonic()
+            if now - self._last_stats_event >= self.policy.stats_every_secs:
+                with self._lock:
+                    self._last_stats_event = now
+                self._emit_router_stats()
+            self._stop.wait(poll)
+
+    def _emit_router_stats(self, final: bool = False) -> None:
+        with self._lock:
+            counters = self._router_counters()
+            healthy = sum(
+                1 for r in self.replicas
+                if r.healthy and not r.draining and not r.abandoned
+            )
+        self._emit("router_stats", final=final, healthy=healthy,
+                   **counters)

@@ -11,6 +11,19 @@ the protocol is four routes of JSON.
                  →   200 {"embedding": [...], "cached": bool}
     POST /v1/knn     same body → 200 {"class": int, "cached": bool}
                      (+"embedding" when "return_embedding" is true)
+    POST /admin/reload  {"pretrained": <path>, "step": <int>?} → hot
+                     weight reload (ISSUE 10): build + warm a new engine
+                     off-path, atomically swap between micro-batches.
+                     200 on swap; 409 {"error": "reload_refused"} when
+                     this process's config can never accept it (kNN
+                     bank, image_size/ladder change — terminal, the
+                     fleet stops retrying); 503 {"error":
+                     "reload_failed"} when the checkpoint couldn't be
+                     loaded/warmed (possibly transient — retried). Old
+                     weights keep serving on every failure.
+                     OPERATOR-ONLY: the fleet router never
+                     proxies /admin/* — only the fleet supervisor (or an
+                     operator on the replica's own port) reaches it.
     GET  /healthz    200 {"status": "ok"} | 503 {"status": "draining"}
     GET  /stats      200 <service.stats()>
 
@@ -25,11 +38,13 @@ from __future__ import annotations
 import base64
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from moco_tpu.serve.batcher import RejectionError
+from moco_tpu.serve.service import ReloadRefusedError
 
 
 def decode_image(req: dict) -> np.ndarray:
@@ -77,7 +92,17 @@ def _make_handler(service):
             self.end_headers()
             self.wfile.write(body)
 
+        def _maybe_wedge(self) -> None:
+            """Chaos `wedge_at_request` (ISSUE 10 fleet drill): once the
+            service is wedged, EVERY route — /healthz included — accepts
+            the connection and then never answers. From outside this is
+            exactly a stuck event loop / dead device: the fleet
+            supervisor's probe-staleness kill is the only way out."""
+            while service.wedged:
+                time.sleep(3600.0)
+
         def do_GET(self):
+            self._maybe_wedge()
             if self.path == "/healthz":
                 # trace state (ISSUE 8 satellite): a balancer/operator sees
                 # "currently profiling" straight from the health probe.
@@ -99,6 +124,10 @@ def _make_handler(service):
                 self._send(404, {"error": "not_found", "path": self.path})
 
         def do_POST(self):
+            self._maybe_wedge()
+            if self.path == "/admin/reload":
+                self._admin_reload()
+                return
             if self.path not in ("/v1/embed", "/v1/knn"):
                 # body must still be consumed on HTTP/1.1 keep-alive
                 self.rfile.read(int(self.headers.get("Content-Length") or 0))
@@ -137,6 +166,42 @@ def _make_handler(service):
                 self._send(400, {"error": "bad_request", "detail": str(e)})
             except Exception as e:  # a handler crash must answer, not hang
                 self._send(500, {"error": "internal", "detail": repr(e)})
+
+        def _admin_reload(self):
+            """Hot weight reload (ISSUE 10). Failures answer 409 with the
+            reason — the old weights keep serving either way, and the
+            caller (the fleet supervisor's reload roll) distinguishes a
+            bad checkpoint from a dead replica by the structured body."""
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict) or not req.get("pretrained"):
+                    raise ValueError('body needs {"pretrained": <path>}')
+                step = req.get("step")
+                step = int(step) if step is not None else None
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                # a malformed REQUEST (non-integer step included) is the
+                # client's bug, not a checkpoint failure: 400, not 409
+                self._send(400, {"error": "bad_request", "detail": str(e)})
+                return
+            if service.draining:
+                self._send(503, {"error": "draining"})
+                return
+            try:
+                entry = service.reload(str(req["pretrained"]), step)
+                self._send(200, {"status": "reloaded", **entry})
+            except ReloadRefusedError as e:
+                # TERMINAL for this process config (kNN bank, image_size,
+                # ladder): 409 — the fleet stops retrying this step here
+                self._send(409, {"error": "reload_refused",
+                                 "detail": str(e)})
+            except ValueError as e:
+                # load/warmup failure: possibly transient (NFS blip, a
+                # momentary OOM) — 503 so the fleet's converge loop
+                # retries on its next pass
+                self._send(503, {"error": "reload_failed", "detail": str(e)})
+            except Exception as e:  # must answer, never hang the roll
+                self._send(503, {"error": "reload_failed", "detail": repr(e)})
 
     return Handler
 

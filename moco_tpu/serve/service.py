@@ -16,6 +16,18 @@ stream training writes (`MetricsRegistry`), emitted every
 `snapshot_every` batches and once at drain — `tools/telemetry_report.py`
 renders the last snapshot as its `serve:` section.
 
+Hot weight reload (ISSUE 10): `reload(path)` builds a SECOND engine from
+a new checkpoint via the configured factory, warms its whole bucket
+ladder off-path (the live engine keeps serving throughout), then swaps
+`self.engine` in one reference assignment. The batcher calls the engine
+through `_run_batch`, which reads `self.engine` exactly once per
+coalesced batch — so every micro-batch executes entirely on one engine
+and the swap lands BETWEEN batches, never inside one. The content-hash
+embedding cache is cleared at swap (its rows are functions of the old
+weights); requests in flight during the swap simply ride whichever
+engine their batch drew — both answer correctly for their weights, and
+nothing is dropped.
+
 Shutdown: `drain()` (SIGTERM in tools/serve.py) stops admission, lets
 every accepted request finish, and flushes the final snapshot — reject
 new, complete old, then exit."""
@@ -37,6 +49,14 @@ from moco_tpu.utils.logging import log_event
 # grow memory and per-snapshot sort cost forever, and an operator wants
 # RECENT percentiles from /stats anyway
 STATS_WINDOW = 8192
+
+
+class ReloadRefusedError(ValueError):
+    """A hot reload that can NEVER succeed for this process's
+    configuration (kNN bank configured, image_size or bucket-ladder
+    change, no factory wired) — distinct from a transient load/warmup
+    failure so the fleet's converge loop knows to STOP retrying
+    (http.py answers 409 for refusals, 503 for retryable failures)."""
 
 
 class EmbedService:
@@ -64,7 +84,25 @@ class EmbedService:
         self.registry = registry
         self.snapshot_every = max(int(snapshot_every), 1)
         self.draining = False
+        self.wedged = False  # chaos wedge_at_request: the front end checks
+                             # this and stops answering (fleet drill)
         self._lock = threading.Lock()
+        # hot reload (ISSUE 10): the factory (path -> un-warmed engine) is
+        # wired by tools/serve.py, which owns the arch/buckets config;
+        # reloads serialize on their own lock so the live request path
+        # never waits on a checkpoint load
+        self._engine_factory = None
+        self._reload_lock = threading.Lock()
+        self.reloads = 0
+        self._reload_history: list[dict] = []
+        self._engine_gen = 0  # bumped at every swap: an in-flight request
+                              # that executed on the OLD engine must not
+                              # repopulate the just-cleared cache
+        self._gen_lock = threading.Lock()  # makes (gen check -> put) in
+                              # embed atomic against (gen += 1 -> clear)
+                              # in reload — a bare check-then-put could
+                              # be descheduled across the whole swap and
+                              # insert a stale row AFTER the clear
         self.requests = 0
         self.served = 0
         self._started = time.monotonic()  # uptime is a duration, not a timestamp
@@ -78,7 +116,7 @@ class EmbedService:
         # /healthz + /stats
         self.tracer = tracer
         self.batcher = MicroBatcher(
-            engine.embed,
+            self._run_batch,
             buckets=engine.buckets,
             flush_ms=flush_ms,
             max_queue=max_queue,
@@ -115,6 +153,14 @@ class EmbedService:
                 knn_bank_size=0 if self._knn is None else len(self._knn["bank"]),
             )
 
+    # -- the engine indirection (hot reload) ---------------------------------
+    def _run_batch(self, images_u8: np.ndarray) -> np.ndarray:
+        """The batcher's executor. Reads `self.engine` EXACTLY once per
+        coalesced batch (one GIL-atomic attribute load), so a concurrent
+        `reload()` swap can only land between micro-batches — every batch
+        runs whole on one engine, never half-and-half."""
+        return self.engine.embed(images_u8)
+
     # -- request paths -------------------------------------------------------
     def embed(self, image: np.ndarray,
               deadline_s: float | None = None) -> tuple[np.ndarray, bool]:
@@ -124,6 +170,8 @@ class EmbedService:
         image = self._validate(image)
         with self._lock:
             self.requests += 1
+            n_requests = self.requests
+        self._maybe_chaos(n_requests)
         t0 = time.monotonic()
         key = None
         if self.cache is not None:
@@ -134,6 +182,7 @@ class EmbedService:
                     self.served += 1
                 self._h_latency.observe(time.monotonic() - t0)
                 return hit, True
+        gen = self._engine_gen  # which engine this request is paying for
         pending = self.batcher.submit(image, deadline_s)
         # generous slack over the request deadline: the batcher ALWAYS
         # resolves accepted requests, so this only guards a dead flusher
@@ -142,7 +191,14 @@ class EmbedService:
         )
         self._h_latency.observe(time.monotonic() - t0)
         if self.cache is not None:
-            self.cache.put(key, result)
+            with self._gen_lock:
+                # a reload swapped engines while this request was in
+                # flight: its row came from the OLD weights and must not
+                # repopulate the just-cleared cache as a forever-stale
+                # hit. Under the lock the check and the put are one unit
+                # against reload's increment-then-clear.
+                if gen == self._engine_gen:
+                    self.cache.put(key, result)
         with self._lock:
             self.served += 1
         return result, False
@@ -178,6 +234,109 @@ class EmbedService:
             )
         return image
 
+    def _maybe_chaos(self, n_requests: int) -> None:
+        """Fleet-drill faults (ISSUE 10): a SIGKILL or an accepting-but-
+        not-answering wedge at the configured request count. Imported
+        lazily: chaos is a drill facility, not a request-path dependency."""
+        from moco_tpu.resilience.chaos import active_chaos
+
+        plan = active_chaos()
+        if plan is None:
+            return
+        plan.maybe_kill_request(n_requests)  # no return: SIGKILL
+        if plan.maybe_wedge_request(n_requests):
+            self.wedged = True  # the front end hangs every LATER request
+
+    # -- hot weight reload (ISSUE 10) ----------------------------------------
+    def set_engine_factory(self, factory) -> None:
+        """`factory(checkpoint_path) -> EmbeddingEngine` (un-warmed).
+        tools/serve.py wires `EmbeddingEngine.from_checkpoint` with its
+        arch/buckets config; tests wire in-process builders."""
+        self._engine_factory = factory
+
+    def reload(self, pretrained: str, step: int | None = None) -> dict:
+        """Build + warm a new engine from `pretrained` OFF the request
+        path, then atomically swap it in (see `_run_batch`). Raises
+        ValueError on any failure — the old engine keeps serving, nothing
+        is dropped. Serialized: concurrent reloads queue on the lock."""
+        if self._engine_factory is None:
+            raise ReloadRefusedError(
+                "hot reload is not configured (no engine factory; serve "
+                "with tools/serve.py or call set_engine_factory)"
+            )
+        with self._reload_lock:
+            # cheap refusals FIRST: every check that needs no (or only an
+            # un-warmed) engine runs before the minutes-scale ladder
+            # warmup, so a refused reload — which a fleet's converge loop
+            # may re-attempt — never burns a checkpoint load + compile
+            if self._knn is not None:
+                # the feature bank was computed by the OLD encoder; new
+                # embeddings live in a different space, so /v1/knn would
+                # silently classify across spaces — refuse, like the
+                # image_size case: regenerate the bank and restart
+                raise ReloadRefusedError(
+                    "hot reload is refused under a configured kNN bank: "
+                    "the bank's features were computed by the old "
+                    "encoder and would silently mismatch the new "
+                    "embedding space — rebuild the bank for the new "
+                    "checkpoint and restart instead"
+                )
+            t0 = time.monotonic()
+            try:
+                new_engine = self._engine_factory(pretrained)
+            except (ValueError, OSError, KeyError) as e:
+                raise ValueError(f"cannot load {pretrained!r}: {e}") from e
+            if new_engine.image_size != self.engine.image_size:
+                raise ReloadRefusedError(
+                    f"reload changes image_size "
+                    f"{self.engine.image_size} -> {new_engine.image_size}; "
+                    "the request contract is per-process, restart instead"
+                )
+            if tuple(new_engine.buckets) != tuple(self.engine.buckets):
+                raise ReloadRefusedError(
+                    f"reload changes the bucket ladder "
+                    f"{tuple(self.engine.buckets)} -> "
+                    f"{tuple(new_engine.buckets)}: the micro-batcher "
+                    "coalesces to the OLD ladder, so a smaller one would "
+                    "overflow live batches and a different one would "
+                    "compile on-path"
+                )
+            try:
+                feat_dim = new_engine.warmup()  # whole ladder, off-path
+            except (ValueError, OSError, KeyError) as e:
+                raise ValueError(f"cannot load {pretrained!r}: {e}") from e
+            warm_s = time.monotonic() - t0
+            # THE swap: one reference assignment; the next micro-batch the
+            # flusher executes reads the new engine
+            self.engine = new_engine
+            self.feat_dim = feat_dim
+            with self._gen_lock:
+                # cached rows are functions of the OLD weights; serving
+                # them after the swap would silently mix model versions.
+                # Increment + clear under the gen lock so no in-flight
+                # old-engine request can slip a row in after the clear.
+                self._engine_gen += 1
+                if self.cache is not None:
+                    self.cache.clear()
+            entry = {
+                "step": step,
+                "pretrained": pretrained,
+                "warm_s": round(warm_s, 3),
+                "feat_dim": feat_dim,
+            }
+            with self._lock:
+                self.reloads += 1
+                self._reload_history.append(entry)
+                del self._reload_history[:-16]  # bounded: /stats payload
+            log_event(
+                "serve",
+                f"hot-reloaded weights from {pretrained} "
+                f"(step {step}, ladder warmed in {warm_s:.1f}s)",
+            )
+            if self.registry is not None:
+                self.registry.emit("event", event="serve_reload", **entry)
+            return entry
+
     # -- telemetry -----------------------------------------------------------
     def _note_batch(self, n: int, bucket: int, wait_s: float) -> None:
         self._h_queue_wait.observe(wait_s)
@@ -212,6 +371,10 @@ class EmbedService:
             "draining": self.draining,
             "uptime_s": round(time.monotonic() - self._started, 1),
         }
+        with self._lock:
+            if self.reloads:
+                out["reloads"] = self.reloads
+                out["reload_history"] = list(self._reload_history)
         if self.cache is not None:
             out["cache"] = {
                 "hits": self.cache.hits,

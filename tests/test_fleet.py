@@ -1,0 +1,1203 @@
+"""Serve fleet suite (ISSUE 10).
+
+Four layers:
+  - pure units: router pick/retry/shed semantics against in-thread stub
+    backends (no child processes, no jax), checkpoint-watcher
+    verify/quarantine, exit-code 48 classification;
+  - stub-replica e2e: the REAL FleetSupervisor loop driving tiny python
+    stub replicas — restart policy, the accepting-but-not-answering
+    wedge kill, drain-aware rolling restart under load, the
+    32-client SIGKILL drill with the zero-lost contract, and the
+    watcher's reload roll + relaunch convergence — seconds-cheap,
+    tier-1;
+  - in-process jax: hot reload swap bit-identical to a cold start on
+    the new checkpoint, cache invalidation, /admin/reload wire
+    contract, reload-failure leaves the old weights serving;
+  - the full soak (slow): 2 REAL tools/serve.py replicas under the
+    fleet, closed-loop load through a replica SIGKILL and a
+    watcher-driven hot reload, embeddings verified against a fresh
+    engine on the new checkpoint.
+"""
+
+from __future__ import annotations
+
+import base64
+import importlib.util
+import json
+import os
+import signal
+import socket
+import textwrap
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from moco_tpu.resilience.chaos import truncate_checkpoint
+from moco_tpu.resilience.integrity import write_manifest
+from moco_tpu.serve.fleet import (
+    CheckpointWatcher,
+    FleetPolicy,
+    FleetSupervisor,
+    ReplicaState,
+    pick_free_port,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+serve_bench = _load_tool("serve_bench")
+telemetry_report = _load_tool("telemetry_report")
+
+FAST_POLICY = dict(
+    probe_secs=0.1, probe_timeout_s=0.5, health_stale_secs=1.0,
+    startup_grace_secs=15.0, term_grace_secs=1.0,
+    backoff_base_secs=0.05, backoff_max_secs=0.2, backoff_jitter=0.0,
+    request_timeout_s=10.0, watch_poll_secs=0.1, stats_every_secs=1.0,
+)
+
+
+# ---------------------------------------------------------------------------
+# router semantics (in-thread stub backends, no child processes)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    """Stands in for a live Popen in router-only tests."""
+
+    pid = 4242
+
+    def poll(self):
+        return None
+
+
+def _stub_backend(response=None, status=200):
+    """One in-thread HTTP backend answering every POST with `response`."""
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            body = json.dumps(
+                response if response is not None
+                else {"embedding": [float(self.server.server_address[1])]}
+            ).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    class S(ThreadingHTTPServer):
+        daemon_threads = True
+
+    srv = S(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _router_fleet(tmp_path, ports, healthy=None):
+    """A FleetSupervisor with hand-built replica states (no start(), no
+    monitor thread): exactly the router logic under test."""
+    fleet = FleetSupervisor(
+        lambda *a: ["true"], replicas=len(ports),
+        telemetry_dir=str(tmp_path / "fleet_t"),
+        policy=FleetPolicy(**FAST_POLICY),
+    )
+    for i, port in enumerate(ports):
+        r = ReplicaState(i, "127.0.0.1", port,
+                         str(tmp_path / f"r{i}"), budget=3)
+        r.proc = _FakeProc()
+        r.healthy = True if healthy is None else healthy[i]
+        fleet.replicas.append(r)
+    return fleet
+
+
+def test_router_least_outstanding_pick(tmp_path):
+    fleet = _router_fleet(tmp_path, [1001, 1002, 1003])
+    fleet.replicas[0].outstanding = 2
+    fleet.replicas[1].outstanding = 0
+    fleet.replicas[2].outstanding = 1
+    picked = fleet.pick_backend()
+    assert picked.index == 1
+    assert picked.outstanding == 1  # pick reserves a slot
+    fleet.release_backend(picked)
+    assert picked.outstanding == 0
+    # draining/ejected/excluded replicas never picked
+    fleet.replicas[1].draining = True
+    assert fleet.pick_backend(exclude=(2,)).index == 0
+
+
+def test_router_retries_once_on_dead_replica_then_succeeds(tmp_path):
+    live = _stub_backend()
+    dead_port = pick_free_port()  # nothing listening: connection refused
+    fleet = _router_fleet(
+        tmp_path, [dead_port, live.server_address[1]]
+    )
+    try:
+        # force the dead replica to be picked first
+        fleet.replicas[1].outstanding = 5
+        status, body = fleet.router_proxy("/v1/embed", b"{}")
+        assert status == 200
+        assert json.loads(body)["embedding"] == [live.server_address[1]]
+        assert fleet.r_retries == 1 and fleet.r_retry_ok == 1
+        # the dead replica was ejected: re-admission is the probe's job
+        assert fleet.replicas[0].healthy is False
+        assert [e["event"] for e in fleet.incidents].count("eject") == 1
+    finally:
+        live.shutdown()
+
+
+def test_router_both_attempts_fail_structured_502(tmp_path):
+    fleet = _router_fleet(
+        tmp_path, [pick_free_port(), pick_free_port()]
+    )
+    status, body = fleet.router_proxy("/v1/embed", b"{}")
+    resp = json.loads(body)
+    assert status == 502 and resp["error"] == "upstream_error"
+    assert resp["retry_after_ms"] > 0
+    assert fleet.r_upstream_error == 1
+
+
+def test_router_sheds_structured_503_when_no_healthy_backend(tmp_path):
+    fleet = _router_fleet(tmp_path, [1001], healthy=[False])
+    t0 = time.monotonic()
+    status, body = fleet.router_proxy("/v1/embed", b"{}")
+    resp = json.loads(body)
+    assert time.monotonic() - t0 < 1.0  # shed immediately, never stalls
+    assert status == 503 and resp["error"] == "no_healthy_backend"
+    assert resp["retry_after_ms"] > 0
+    assert fleet.r_shed_no_backend == 1
+    assert any(e["event"] == "no_backend" for e in fleet.incidents)
+
+
+def test_router_passes_replica_rejections_through(tmp_path):
+    shed = _stub_backend(response={"error": "overloaded",
+                                   "retry_after_ms": 5.0}, status=503)
+    fleet = _router_fleet(tmp_path, [shed.server_address[1]])
+    try:
+        status, body = fleet.router_proxy("/v1/embed", b"{}")
+        assert status == 503
+        assert json.loads(body)["error"] == "overloaded"
+        # a structured ANSWER from a live replica is not a router failure:
+        # no retry, no ejection
+        assert fleet.r_retries == 0
+        assert fleet.replicas[0].healthy is True
+        assert fleet.r_passthrough_error == 1
+    finally:
+        shed.shutdown()
+
+
+def test_router_deadline_from_body_wins(tmp_path):
+    fleet = _router_fleet(tmp_path, [1001])
+    assert fleet._deadline_s(b'{"pixels": [1]}') == \
+        fleet.policy.request_timeout_s
+    assert fleet._deadline_s(b'{"deadline_ms": 250}') == 0.25
+    # malformed body: default deadline, the replica answers the 400
+    assert fleet._deadline_s(b'{"deadline_ms": oops') == \
+        fleet.policy.request_timeout_s
+
+
+# ---------------------------------------------------------------------------
+# checkpoint watcher (verify -> deploy / quarantine)
+# ---------------------------------------------------------------------------
+
+
+def _export_step(watch_dir, step, payload=b"w" * 4096, manifest=True,
+                 name="encoder.npz"):
+    d = watch_dir / str(step)
+    d.mkdir(parents=True)
+    (d / name).write_bytes(payload)
+    if manifest:
+        write_manifest(str(watch_dir), step)
+    return str(d / name)
+
+
+def test_watcher_deploys_only_manifested_verified_steps(tmp_path):
+    watch = tmp_path / "export"
+    watch.mkdir()
+    events = []
+    w = CheckpointWatcher(str(watch),
+                          emit=lambda e, **f: events.append((e, f)))
+    assert w.poll_once() is None  # empty dir
+    _export_step(watch, 10, manifest=False)
+    # manifest-less = still being written: NOT deployable yet
+    assert w.poll_once() is None
+    write_manifest(str(watch), 10)
+    step, payload = w.poll_once()
+    assert step == 10 and payload.endswith("encoder.npz")
+    assert w.poll_once() is None  # nothing new
+    # newest verified wins; older undeployed steps are skipped
+    _export_step(watch, 20)
+    _export_step(watch, 30)
+    step, _ = w.poll_once()
+    assert step == 30
+    assert w.poll_once() is None
+
+
+def test_watcher_quarantines_truncated_checkpoint(tmp_path):
+    """The acceptance drill: a truncated export is quarantined loudly and
+    NEVER deployed; a later valid step still deploys."""
+    watch = tmp_path / "export"
+    watch.mkdir()
+    events = []
+    w = CheckpointWatcher(str(watch),
+                          emit=lambda e, **f: events.append((e, f)))
+    _export_step(watch, 10)
+    assert w.poll_once()[0] == 10
+    _export_step(watch, 20)
+    truncate_checkpoint(str(watch), 20)  # torn mid-write
+    assert w.poll_once() is None  # nothing deployable
+    assert [e for e, _ in events] == ["reload_quarantine"]
+    assert events[0][1]["step"] == 20
+    assert not (watch / "20").exists()
+    assert os.path.isdir(str(watch / ".quarantine" / "20"))
+    _export_step(watch, 21)
+    assert w.poll_once()[0] == 21
+
+
+def test_watcher_payload_selection(tmp_path):
+    watch = tmp_path / "export"
+    watch.mkdir()
+    d = watch / "5"
+    d.mkdir()
+    (d / "notes.txt").write_bytes(b"x")
+    (d / "encoder.safetensors").write_bytes(b"w" * 512)
+    write_manifest(str(watch), 5)
+    w = CheckpointWatcher(str(watch))
+    step, payload = w.poll_once()
+    assert step == 5 and payload.endswith("encoder.safetensors")
+
+
+# ---------------------------------------------------------------------------
+# exit-code protocol
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_bind_exit_code_is_fatal():
+    from moco_tpu.resilience.exitcodes import EXIT_FLEET_BIND
+    from moco_tpu.resilience.supervisor import FATAL_CLASSES, classify_exit
+
+    assert EXIT_FLEET_BIND == 48
+    cls, detail = classify_exit(48)
+    assert cls == "fleet_bind"
+    assert "fleet_bind" in FATAL_CLASSES
+
+
+def test_serve_fleet_cli_bind_failure_exits_48(tmp_path):
+    serve_fleet = _load_tool("serve_fleet")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        s.listen(1)
+        taken = s.getsockname()[1]
+        rc = serve_fleet.main([
+            "--replicas", "1", "--port", str(taken),
+            "--telemetry-dir", str(tmp_path / "t"), "--", "true",
+        ])
+    assert rc == 48
+    # and the config error path: no replica command at all
+    assert serve_fleet.main(
+        ["--replicas", "1", "--telemetry-dir", str(tmp_path / "t2")]
+    ) == 45
+
+
+def test_serve_fleet_cli_unspawnable_replica_exits_45_not_48(tmp_path):
+    """A replica command that can never exec is a CONFIG error (45), not
+    the reschedule-semantics bind failure (48) — and a partial start
+    must not leak the replicas that did spawn."""
+    serve_fleet = _load_tool("serve_fleet")
+    rc = serve_fleet.main([
+        "--replicas", "2", "--port", "0",
+        "--telemetry-dir", str(tmp_path / "t"), "--",
+        str(tmp_path / "no_such_binary"),
+    ])
+    assert rc == 45
+
+
+def test_fleet_import_is_stdlib_only():
+    """The R11 boundary's runtime twin: a fresh process importing the
+    fleet module (and the CLI's imports) must pull neither numpy nor
+    jax — the routing tier survives what kills the replicas."""
+    import subprocess
+    import sys as _sys
+
+    code = (
+        "import sys\n"
+        "import moco_tpu.serve.fleet\n"
+        "bad = sorted({m.split('.')[0] for m in sys.modules} & "
+        "{'numpy', 'jax', 'optax', 'orbax', 'flax'})\n"
+        "assert not bad, bad\n"
+    )
+    r = subprocess.run([_sys.executable, "-c", code], capture_output=True,
+                       text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# stub-replica e2e: the real fleet loop, seconds-cheap children
+# ---------------------------------------------------------------------------
+
+_STUB_REPLICA = textwrap.dedent("""\
+    import argparse, json, os, signal, sys, threading, time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--telemetry-dir", required=True)
+    p.add_argument("--pretrained", default="boot")
+    p.add_argument("--behavior", default="ok")
+    args, _ = p.parse_known_args()
+
+    state = {"draining": False, "wedged": False, "requests": 0,
+             "pretrained": args.pretrained, "reloads": 0}
+
+    if args.behavior == "exit1":
+        sys.exit(1)
+    wedge_after = None
+    if args.behavior.startswith("wedge_after="):
+        wedge_after = int(args.behavior.split("=")[1])
+        # a truly wedged process doesn't honor SIGTERM either: force the
+        # fleet's SIGTERM -> grace -> SIGKILL escalation
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        def log_message(self, *a):
+            pass
+        def _send(self, status, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        def _wedge(self):
+            while state["wedged"]:
+                time.sleep(3600.0)
+        def do_GET(self):
+            self._wedge()
+            if self.path == "/healthz":
+                if state["draining"]:
+                    self._send(503, {"status": "draining"})
+                else:
+                    self._send(200, {"status": "ok"})
+            elif self.path == "/stats":
+                self._send(200, dict(state, pid=os.getpid()))
+            else:
+                self._send(404, {"error": "not_found"})
+        def do_POST(self):
+            self._wedge()
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n)
+            if self.path == "/admin/reload":
+                req = json.loads(body or b"{}")
+                if not req.get("pretrained"):
+                    self._send(400, {"error": "bad_request"})
+                    return
+                state["pretrained"] = req["pretrained"]
+                state["reloads"] += 1
+                self._send(200, {"status": "reloaded",
+                                 "step": req.get("step")})
+                return
+            if self.path in ("/v1/embed", "/v1/knn"):
+                state["requests"] += 1
+                if wedge_after is not None and \\
+                        state["requests"] >= wedge_after:
+                    state["wedged"] = True
+                if state["draining"]:
+                    self._send(503, {"error": "draining"})
+                    return
+                self._send(200, {"embedding": [1.0, float(args.port)],
+                                 "cached": False,
+                                 "pretrained": state["pretrained"]})
+                return
+            self._send(404, {"error": "not_found"})
+
+    class S(ThreadingHTTPServer):
+        daemon_threads = True
+        request_queue_size = 128
+
+    srv = S(("127.0.0.1", args.port), H)
+    stop = threading.Event()
+    def term(signum, frame):
+        state["draining"] = True
+        stop.set()
+    if wedge_after is None:
+        signal.signal(signal.SIGTERM, term)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    while not stop.is_set():
+        time.sleep(0.02)
+    time.sleep(0.05)  # "drain" the in-flight work
+    srv.shutdown()
+    sys.exit(0)
+""")
+
+
+def _stub_fleet(tmp_path, n=2, behavior="ok", watch_dir="", **policy_kw):
+    import sys as _sys
+
+    stub = tmp_path / "stub_replica.py"
+    stub.write_text(_STUB_REPLICA)
+    kw = dict(FAST_POLICY)
+    kw.update(policy_kw)
+
+    def child_argv(index, port, tdir, pretrained):
+        argv = [_sys.executable, str(stub), "--port", str(port),
+                "--telemetry-dir", tdir, "--behavior",
+                behavior if index == 0 else "ok"]
+        if pretrained:
+            argv += ["--pretrained", pretrained]
+        return argv
+
+    return FleetSupervisor(
+        child_argv, replicas=n, telemetry_dir=str(tmp_path / "fleet_t"),
+        policy=FleetPolicy(**kw), watch_dir=watch_dir, seed=0,
+    )
+
+
+def _wait(cond, timeout_s=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _post(url, body, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_e2e_crash_loop_exhausts_budget_and_fleet_fails(tmp_path):
+    """A replica that dies at every launch is abandoned after
+    max_restarts consecutive never-healthy deaths; a 1-replica fleet is
+    then FAILED (the CLI exits nonzero)."""
+    fleet = _stub_fleet(tmp_path, n=1, behavior="exit1", max_restarts=2)
+    fleet.start()
+    try:
+        _wait(lambda: fleet.failed, msg="fleet_give_up")
+        r = fleet.replicas[0]
+        assert r.abandoned and r.launches == 3  # initial + 2 restarts
+        events = [e["event"] for e in fleet.incidents]
+        assert "give_up" in events and "fleet_give_up" in events
+        assert all(c == "crash" for c in r.classifications)
+    finally:
+        fleet.stop()
+
+
+def test_e2e_wedge_is_probe_detected_killed_and_restarted(tmp_path):
+    """The accepting-but-not-answering drill: after the wedge, probes
+    stop answering; the fleet ejects, escalates SIGTERM (ignored) →
+    SIGKILL, classifies the death as a hang, and restores the replica —
+    while the other replica keeps serving the whole time."""
+    fleet = _stub_fleet(tmp_path, n=2, behavior="wedge_after=3",
+                        term_grace_secs=0.5)
+    fleet.start()
+    try:
+        _wait(lambda: fleet.healthy_count() == 2, msg="fleet healthy")
+        url = fleet.router.url
+        wedge_port = fleet.replicas[0].port
+        # drive requests AT the wedged replica's own port to trip the
+        # wedge deterministically (the router would balance away)
+        for _ in range(3):
+            _post(f"http://127.0.0.1:{wedge_port}/v1/embed",
+                  {"pixels": [1]})
+        # the router keeps answering through replica 1 throughout
+        status, _ = _post(url + "/v1/embed", {"pixels": [1]})
+        assert status == 200
+        _wait(lambda: "hang" in fleet.replicas[0].classifications,
+              msg="wedge killed + classified hang")
+        _wait(lambda: fleet.healthy_count() == 2,
+              msg="wedged replica restored")
+        events = [e["event"] for e in fleet.incidents]
+        assert "eject" in events and "kill" in events
+        kills = [e for e in fleet.incidents if e["event"] == "kill"]
+        assert any(k.get("phase") == "sigkill" for k in kills)  # escalated
+    finally:
+        fleet.stop()
+
+
+def test_e2e_rolling_restart_keeps_capacity_under_load(tmp_path):
+    """Drain-aware rolling restart: every replica's pid changes, yet a
+    closed loop running THROUGH the roll loses nothing and the router
+    never sheds for lack of a backend — capacity stayed >= N-1."""
+    fleet = _stub_fleet(tmp_path, n=2)
+    fleet.start()
+    try:
+        _wait(lambda: fleet.healthy_count() == 2, msg="fleet healthy")
+        pids_before = [r.pid for r in fleet.replicas]
+        result = {}
+
+        def load():
+            result.update(serve_bench.run_load(
+                fleet.router.url, concurrency=8, total_requests=400,
+                image_size=8, pool=4, timeout_s=15.0,
+            ))
+
+        loader = threading.Thread(target=load)
+        loader.start()
+        assert fleet.rolling_restart(timeout_s=60.0)
+        loader.join(timeout=60.0)
+        assert not loader.is_alive()
+        assert result["lost"] == 0, result["lost_detail"]
+        assert fleet.r_shed_no_backend == 0  # capacity never hit zero
+        pids_after = [r.pid for r in fleet.replicas]
+        assert all(a != b for a, b in zip(pids_before, pids_after))
+        assert fleet.healthy_count() == 2
+        events = [e["event"] for e in fleet.incidents]
+        assert events.count("roll_replica") >= 4  # drain+done per replica
+        assert "roll_end" in events
+    finally:
+        fleet.stop()
+
+
+def test_e2e_kill_drill_32_clients_zero_lost(tmp_path):
+    """THE acceptance drill: 32 closed-loop clients, SIGKILL one of two
+    replicas mid-load → zero lost requests (the router's single retry
+    absorbs the in-flight failures), the fleet restores N replicas, and
+    every transition is a `kind:"fleet"` event under ONE run_id."""
+    fleet = _stub_fleet(tmp_path, n=2)
+    fleet.start()
+    try:
+        _wait(lambda: fleet.healthy_count() == 2, msg="fleet healthy")
+        victim_pid = fleet.replicas[0].pid
+        killed = {}
+
+        def killer():
+            time.sleep(0.15)  # mid-load, not before it
+            os.kill(victim_pid, signal.SIGKILL)
+            killed["pid"] = victim_pid
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        summary = serve_bench.run_load(
+            fleet.router.url, concurrency=32, total_requests=1024,
+            image_size=8, pool=4, timeout_s=15.0,
+        )
+        kt.join(timeout=5.0)
+        assert killed["pid"] == victim_pid
+        assert summary["lost"] == 0, summary["lost_detail"]
+        assert summary["resolved"] == summary["sent"] == 1024
+        assert summary["ok"] >= 1000  # at most a few structured sheds
+        _wait(lambda: "killed" in fleet.replicas[0].classifications,
+              msg="death observed and classified")
+        _wait(lambda: fleet.healthy_count() == 2,
+              msg="fleet restored to N replicas")
+    finally:
+        fleet.stop()
+
+    # the whole story is one events.jsonl under one run_id, and the
+    # report tool renders it from the DIRECTORY (telemetry satellite)
+    events_path = os.path.join(str(tmp_path / "fleet_t"), "events.jsonl")
+    records = [json.loads(ln) for ln in open(events_path)
+               if ln.strip()]
+    fleet_records = [r for r in records if r.get("kind") == "fleet"]
+    assert {r["run_id"] for r in fleet_records} == {fleet.run_id}
+    events = [r["event"] for r in fleet_records]
+    for expected in ("fleet_start", "launch", "replica_exit",
+                     "replica_healthy", "router_stats", "fleet_stop"):
+        assert expected in events, expected
+    pairs = telemetry_report.expand_events_arg(str(tmp_path / "fleet_t"))
+    assert ("fleet", events_path) in pairs
+    records, _ = telemetry_report.load_events_multi(pairs)
+    summary = telemetry_report.summarize(records)
+    flt = summary["fleet"]
+    assert flt["size"] == 2
+    assert flt["replicas"][0]["restarts"] >= 1
+    assert "killed" in flt["replicas"][0]["classifications"]
+    assert flt["router"]["requests"] >= 1024
+    rendered = telemetry_report.render(summary)
+    assert "fleet:" in rendered and "replica 0:" in rendered
+
+
+def test_e2e_reload_roll_and_relaunch_convergence(tmp_path):
+    """Watcher e2e against stub replicas: a verified step rolls across
+    every replica via /admin/reload; a truncated later step is
+    quarantined and never deployed; a replica KILLED after the roll
+    comes back booted on the deployed payload (argv convergence)."""
+    watch = tmp_path / "export"
+    watch.mkdir()
+    fleet = _stub_fleet(tmp_path, n=2, watch_dir=str(watch))
+    fleet.start()
+    try:
+        _wait(lambda: fleet.healthy_count() == 2, msg="fleet healthy")
+        payload = _export_step(watch, 100)
+        _wait(lambda: all(r.deployed_step == 100 for r in fleet.replicas),
+              msg="reload rolled to both replicas")
+        events = [e["event"] for e in fleet.incidents]
+        assert "reload_detected" in events and "reload_done" in events
+        assert events.count("reload_replica") == 2
+        # each stub really swapped: /v1/embed now reports the new payload
+        seen = set()
+        for _ in range(8):
+            _, resp = _post(fleet.router.url + "/v1/embed",
+                            {"pixels": [1]})
+            seen.add(resp["pretrained"])
+        assert seen == {payload}
+
+        # truncated later step: quarantined, target unchanged
+        _export_step(watch, 200)
+        truncate_checkpoint(str(watch), 200)
+        _wait(lambda: any(e["event"] == "reload_quarantine"
+                          for e in fleet.incidents),
+              msg="truncated step quarantined")
+        assert fleet._target_step == 100
+        assert os.path.isdir(str(watch / ".quarantine" / "200"))
+
+        # SIGKILL a replica: its relaunch must boot on the DEPLOYED
+        # payload, not the boot-time weights
+        os.kill(fleet.replicas[1].pid, signal.SIGKILL)
+        _wait(lambda: "killed" in fleet.replicas[1].classifications,
+              msg="death observed")
+        _wait(lambda: fleet.healthy_count() == 2, msg="replica restored")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{fleet.replicas[1].port}/stats", timeout=5
+        ) as r:
+            stats = json.loads(r.read())
+        assert stats["pretrained"] == payload
+        assert fleet.replicas[1].deployed_step == 100
+    finally:
+        fleet.stop()
+
+
+def test_serve_bench_fleet_mode_with_kill_drill(tmp_path):
+    """The serve_bench satellite end to end: --fleet spawns
+    tools/serve_fleet.py per replica count, parses the router url,
+    SIGKILLs a replica via the router's /stats pids, and reports
+    rps/p99/lost rows — lost stays 0 through the drill."""
+    import sys as _sys
+
+    stub = tmp_path / "stub_replica.py"
+    stub.write_text(_STUB_REPLICA)
+    rows = serve_bench.run_fleet_bench(
+        [_sys.executable, str(stub)], counts=(2,),
+        concurrency=16, total_requests=512, image_size=8, pool=4,
+        timeout_s=15.0, kill_drill=True, kill_after_s=0.1,
+        boot_timeout_s=60.0,
+        fleet_args=["--health-stale-secs", "2",
+                    "--term-grace-secs", "1"],
+    )
+    assert len(rows) == 1
+    row = rows[0]
+    assert "error" not in row, row
+    assert row["replicas"] == 2
+    assert row["lost"] == 0, row["lost_detail"]
+    assert row["killed_pid"]  # the drill really fired
+    assert row["throughput_rps"] > 0
+    assert "p99" in row["latency_ms"]
+
+
+# ---------------------------------------------------------------------------
+# hot reload: in-process jax — swap bit-identical to a cold start
+# ---------------------------------------------------------------------------
+
+BUCKETS = (1, 4, 16)
+SIZE = 32
+
+
+@pytest.fixture(scope="module")
+def two_exports(tmp_path_factory):
+    """Two DIFFERENT tiny encoders exported in the torchvision dialect —
+    checkpoint A serves first, checkpoint B hot-reloads over it."""
+    import jax
+    import jax.numpy as jnp
+
+    from moco_tpu.checkpoint import _save_flat, resnet_to_torchvision
+    from moco_tpu.models import build_backbone
+
+    model = build_backbone("resnet_tiny", cifar_stem=True)
+    root = tmp_path_factory.mktemp("exports")
+    paths = []
+    for seed in (0, 1):
+        variables = model.init(
+            jax.random.key(seed), jnp.zeros((1, SIZE, SIZE, 3)),
+            train=False,
+        )
+        flat = resnet_to_torchvision(
+            jax.tree.map(np.asarray, variables["params"]),
+            jax.tree.map(np.asarray, variables.get("batch_stats", {})),
+            prefix="module.encoder_q.",
+        )
+        path = str(root / f"encoder_{seed}.npz")
+        _save_flat(flat, path)
+        paths.append(path)
+    return paths
+
+
+def _engine_from(path):
+    from moco_tpu.serve import EmbeddingEngine
+
+    return EmbeddingEngine.from_checkpoint(
+        path, "resnet_tiny", image_size=SIZE, cifar_stem=True,
+        buckets=BUCKETS,
+    )
+
+
+def _imgs(n, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (n, SIZE, SIZE, 3)
+    ).astype(np.uint8)
+
+
+def test_reload_swap_bit_identical_to_cold_start(two_exports):
+    """ISSUE 10 acceptance: after reload(B), served embeddings are
+    BIT-identical to a freshly cold-started engine on checkpoint B; the
+    content-hash cache is invalidated at the swap (old-weight rows must
+    never answer for the new weights)."""
+    from moco_tpu.serve import EmbedService
+
+    path_a, path_b = two_exports
+    service = EmbedService(_engine_from(path_a), flush_ms=2.0,
+                           max_queue=32, request_deadline_ms=10_000.0,
+                           cache_mb=4)
+    service.set_engine_factory(_engine_from)
+    try:
+        img = _imgs(1, seed=7)[0]
+        before, cached = service.embed(img)
+        assert cached is False
+        _, cached = service.embed(img)
+        assert cached is True  # warmed the cache on the OLD weights
+
+        entry = service.reload(path_b, step=123)
+        assert entry["step"] == 123 and entry["warm_s"] >= 0.0
+
+        after, cached = service.embed(img)
+        assert cached is False  # cache cleared at the swap
+        cold = _engine_from(path_b)
+        cold.warmup()
+        expected = cold.embed(img[None])[0]
+        assert np.array_equal(after, expected)  # bit-identical
+        assert not np.array_equal(after, before)  # weights really changed
+        assert service.stats()["reloads"] == 1
+        assert service.stats()["reload_history"][0]["step"] == 123
+    finally:
+        service.drain(timeout_s=10.0)
+
+
+def test_reload_failure_keeps_old_weights_serving(two_exports):
+    from moco_tpu.serve import EmbedService
+
+    path_a, _ = two_exports
+    service = EmbedService(_engine_from(path_a), flush_ms=2.0,
+                           max_queue=32, request_deadline_ms=10_000.0)
+    service.set_engine_factory(_engine_from)
+    try:
+        img = _imgs(1, seed=9)[0]
+        before, _ = service.embed(img)
+        with pytest.raises(ValueError, match="cannot load"):
+            service.reload(path_a + ".does_not_exist")
+        after, _ = service.embed(img)
+        assert np.array_equal(before, after)  # old engine untouched
+        assert service.reloads == 0
+    finally:
+        service.drain(timeout_s=10.0)
+
+
+def test_reload_refused_on_ladder_change_and_knn_bank(two_exports):
+    """Guards the swap's contracts: a factory producing a DIFFERENT
+    bucket ladder would overflow live coalesced batches (the batcher
+    still coalesces to the old ladder), and a configured kNN bank was
+    computed by the OLD encoder — both refuse, old weights keep
+    serving."""
+    from moco_tpu.serve import EmbeddingEngine, EmbedService
+
+    path_a, path_b = two_exports
+    service = EmbedService(_engine_from(path_a), flush_ms=2.0,
+                           max_queue=32, request_deadline_ms=10_000.0)
+
+    def smaller_ladder(path):
+        return EmbeddingEngine.from_checkpoint(
+            path, "resnet_tiny", image_size=SIZE, cifar_stem=True,
+            buckets=(1, 4),
+        )
+
+    service.set_engine_factory(smaller_ladder)
+    try:
+        with pytest.raises(ValueError, match="bucket ladder"):
+            service.reload(path_b)
+        assert service.reloads == 0
+    finally:
+        service.drain(timeout_s=10.0)
+
+    engine = _engine_from(path_a)
+    engine.warmup()
+    bank = engine.embed(_imgs(8, seed=1))
+    service = EmbedService(engine, flush_ms=2.0, max_queue=32,
+                           request_deadline_ms=10_000.0,
+                           knn_bank=bank, knn_labels=np.arange(8) % 2,
+                           knn_k=3)
+    service.set_engine_factory(_engine_from)
+    try:
+        with pytest.raises(ValueError, match="kNN bank"):
+            service.reload(path_b)
+        # old weights (and the matching bank) still serve
+        cls_id, _, _ = service.classify(_imgs(1, seed=2)[0])
+        assert cls_id in (0, 1)
+    finally:
+        service.drain(timeout_s=10.0)
+
+
+class _GatedStubEngine:
+    """A jax-free engine stand-in whose embed() can be held closed —
+    deterministic interleavings for the swap-vs-in-flight races."""
+
+    image_size = 8
+    buckets = (1, 4)
+
+    def __init__(self, value, gate=None):
+        self.value = float(value)
+        self.gate = gate
+
+    def warmup(self):
+        return 2
+
+    def embed(self, images_u8):
+        if self.gate is not None and not self.gate.wait(timeout=10.0):
+            raise RuntimeError("test gate never released")
+        return np.full((len(images_u8), 2), self.value, np.float32)
+
+
+def test_reload_does_not_let_inflight_old_rows_repopulate_cache():
+    """A request whose batch executed on the OLD engine resolves AFTER
+    the swap cleared the cache: its stale row must not be cached (a
+    content-hash hit would then serve old-model embeddings forever)."""
+    import threading as _threading
+
+    from moco_tpu.serve import EmbedService
+
+    gate = _threading.Event()
+    old = _GatedStubEngine(1.0, gate=gate)
+    service = EmbedService(old, flush_ms=1.0, max_queue=16,
+                           request_deadline_ms=30_000.0, cache_mb=4)
+    service.set_engine_factory(
+        lambda path: _GatedStubEngine(2.0))
+    try:
+        img = np.zeros((8, 8, 3), np.uint8)
+        result = {}
+
+        def request():
+            result["row"], result["cached"] = service.embed(img)
+
+        t = _threading.Thread(target=request)
+        t.start()
+        time.sleep(0.3)  # the batch is now blocked INSIDE the old engine
+        reloader = _threading.Thread(
+            target=lambda: result.update(swap=service.reload("new")))
+        reloader.start()
+        time.sleep(0.3)
+        gate.set()  # old-engine batch completes AFTER the swap
+        t.join(timeout=10.0)
+        reloader.join(timeout=10.0)
+        assert result["row"][0] == 1.0  # the in-flight answer is honest
+        # ... but the NEXT request must not hit a stale cache entry
+        row, cached = service.embed(img)
+        assert cached is False
+        assert row[0] == 2.0  # new engine, not the old cached row
+    finally:
+        service.drain(timeout_s=10.0)
+
+
+def test_reload_refusals_are_cheap_factory_never_called(two_exports):
+    """The kNN-bank refusal must fire BEFORE the factory: a fleet's
+    converge loop may re-attempt, and each late refusal would cost a
+    full checkpoint load + ladder warmup on the serving replica."""
+    from moco_tpu.serve import EmbedService
+
+    path_a, path_b = two_exports
+    engine = _engine_from(path_a)
+    engine.warmup()
+    bank = engine.embed(_imgs(4, seed=1))
+    service = EmbedService(engine, flush_ms=2.0, max_queue=16,
+                           request_deadline_ms=5_000.0,
+                           knn_bank=bank, knn_labels=np.arange(4) % 2,
+                           knn_k=3)
+
+    def exploding_factory(path):
+        raise AssertionError("factory must not run for a refused reload")
+
+    service.set_engine_factory(exploding_factory)
+    try:
+        with pytest.raises(ValueError, match="kNN bank"):
+            service.reload(path_b)
+    finally:
+        service.drain(timeout_s=5.0)
+
+
+def test_fleet_409_refusal_is_terminal_not_retried(tmp_path):
+    """A replica that answers 409 to /admin/reload (kNN bank, ladder
+    change) must not be re-asked every pass — each attempt would make it
+    load + warm a checkpoint just to refuse again."""
+    refuse = _stub_backend(response={"error": "reload_refused",
+                                     "detail": "kNN bank"}, status=409)
+    fleet = _router_fleet(tmp_path, [refuse.server_address[1]])
+    try:
+        with fleet._lock:
+            fleet._target_step, fleet._target_path = 7, "/x/encoder.npz"
+        fleet._reload_sync()
+        fleet._reload_sync()  # the converge loop coming around again
+        r = fleet.replicas[0]
+        assert r.reload_refused_step == 7
+        assert r.deployed_step == -1
+        fails = [e for e in fleet.incidents
+                 if e["event"] == "reload_failed"]
+        assert len(fails) == 1  # announced once, then terminal
+        # the monitor's need_sync predicate now excludes it
+        assert not (r.deployed_step < fleet._target_step
+                    and r.reload_refused_step < fleet._target_step)
+    finally:
+        refuse.shutdown()
+
+    # a TRANSIENT failure (503 reload_failed) must stay retryable: no
+    # terminal mark, so the converge loop keeps trying
+    flaky = _stub_backend(response={"error": "reload_failed",
+                                    "detail": "NFS blip"}, status=503)
+    fleet2 = _router_fleet(tmp_path / "f2", [flaky.server_address[1]])
+    try:
+        with fleet2._lock:
+            fleet2._target_step, fleet2._target_path = 9, "/x/e.npz"
+        fleet2._reload_sync()
+        r = fleet2.replicas[0]
+        assert r.reload_refused_step == -1  # NOT terminal
+        assert (r.deployed_step < fleet2._target_step
+                and r.reload_refused_step < fleet2._target_step)
+    finally:
+        flaky.shutdown()
+
+
+def test_roll_skips_abandoned_replica_instead_of_wedging(tmp_path):
+    """A replica abandoned after roll-begin will never come alive: the
+    roll must skip it (and finish), not wait on it forever."""
+    fleet = _stub_fleet(tmp_path, n=2)
+    fleet.start()
+    try:
+        _wait(lambda: fleet.healthy_count() == 2, msg="fleet healthy")
+        pid1 = fleet.replicas[1].pid
+        # the hazard is abandonment AFTER roll-begin (roll-begin already
+        # filters): inject a roll whose queue still holds replica 0 and
+        # abandon it — the monitor thread advances the roll from here
+        with fleet._lock:
+            fleet.replicas[0].abandoned = True
+            fleet._roll = {"queue": [0, 1], "idx": None,
+                           "phase": "await", "t": 0.0}
+        _wait(lambda: any(e["event"] == "roll_end"
+                          for e in fleet.incidents),
+              timeout_s=30.0, msg="roll completed despite abandonment")
+        assert fleet.replicas[1].pid != pid1  # replica 1 really rolled
+        skipped = [e for e in fleet.incidents
+                   if e["event"] == "roll_replica"
+                   and e.get("phase") == "skipped"]
+        assert skipped and skipped[0]["replica"] == 0
+    finally:
+        fleet.stop()
+
+
+def test_reload_unconfigured_raises():
+    import jax
+    import jax.numpy as jnp
+
+    from moco_tpu.models import build_backbone
+    from moco_tpu.serve import EmbeddingEngine, EmbedService
+
+    model = build_backbone("resnet_tiny", cifar_stem=True)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, SIZE, SIZE, 3)), train=False
+    )
+    engine = EmbeddingEngine(model, variables["params"],
+                             variables.get("batch_stats", {}),
+                             image_size=SIZE, buckets=(1, 4))
+    service = EmbedService(engine, flush_ms=2.0, max_queue=16,
+                           request_deadline_ms=5_000.0)
+    try:
+        with pytest.raises(ValueError, match="not configured"):
+            service.reload("whatever.npz")
+    finally:
+        service.drain(timeout_s=5.0)
+
+
+def test_admin_reload_http_contract(two_exports):
+    """POST /admin/reload over the wire: 400 on a bad body, 409 with the
+    reason on a bad checkpoint, 200 + swapped weights on a good one —
+    and the swap is visible in served embeddings immediately."""
+    from moco_tpu.serve import EmbedService, ServeFrontend
+
+    path_a, path_b = two_exports
+    service = EmbedService(_engine_from(path_a), flush_ms=2.0,
+                           max_queue=32, request_deadline_ms=10_000.0)
+    service.set_engine_factory(_engine_from)
+    frontend = ServeFrontend(service, port=0)
+    frontend.start()
+    try:
+        status, resp = _post(frontend.url + "/admin/reload", {})
+        assert status == 400 and resp["error"] == "bad_request"
+        # a malformed step is the CLIENT's bug: 400, never mis-bucketed
+        # as a 409 checkpoint failure
+        status, resp = _post(frontend.url + "/admin/reload",
+                             {"pretrained": path_b, "step": "abc"})
+        assert status == 400 and resp["error"] == "bad_request"
+        # a load failure is possibly TRANSIENT: 503 reload_failed (the
+        # fleet retries), never the terminal 409
+        status, resp = _post(frontend.url + "/admin/reload",
+                             {"pretrained": "/nope.npz"})
+        assert status == 503 and resp["error"] == "reload_failed"
+        status, resp = _post(frontend.url + "/admin/reload",
+                             {"pretrained": path_b, "step": 7})
+        assert status == 200 and resp["status"] == "reloaded"
+        assert resp["step"] == 7
+
+        img = _imgs(1, seed=11)[0]
+        body = {"image_b64": base64.b64encode(img.tobytes()).decode(),
+                "shape": list(img.shape)}
+        status, resp = _post(frontend.url + "/v1/embed", body)
+        assert status == 200
+        cold = _engine_from(path_b)
+        cold.warmup()
+        assert np.array_equal(
+            np.asarray(resp["embedding"], np.float32),
+            cold.embed(img[None])[0],
+        )
+    finally:
+        service.drain(timeout_s=10.0)
+        frontend.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the full soak: real serve.py replicas, kill drill + watcher hot reload
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_soak_real_replicas_kill_and_hot_reload(two_exports,
+                                                      tmp_path):
+    """ISSUE 10 acceptance, full stack: 2 REAL tools/serve.py replicas
+    under the fleet; closed-loop load survives a replica SIGKILL with
+    zero lost; a new manifested checkpoint dropped into the watch dir
+    rolls across the fleet with zero dropped requests and embeddings
+    bit-identical to a fresh engine on it; a truncated checkpoint is
+    quarantined and never loaded."""
+    import sys as _sys
+
+    path_a, path_b = two_exports
+    watch = tmp_path / "export"
+    watch.mkdir()
+    serve_py = os.path.join(REPO, "tools", "serve.py")
+
+    def child_argv(index, port, tdir, pretrained):
+        argv = [_sys.executable, "-u", serve_py,
+                "--pretrained", pretrained or path_a,
+                "--arch", "resnet_tiny", "--image-size", str(SIZE),
+                "--cifar-stem", "true", "--buckets", "1", "4", "16",
+                "--flush-ms", "5.0",
+                "--port", str(port), "--telemetry-dir", tdir,
+                "--snapshot-every", "5"]
+        return argv
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", MOCO_TPU_NO_CACHE="1")
+    fleet = FleetSupervisor(
+        child_argv, replicas=2, telemetry_dir=str(tmp_path / "fleet_t"),
+        watch_dir=str(watch), env=env,
+        policy=FleetPolicy(
+            probe_secs=0.2, probe_timeout_s=2.0, health_stale_secs=10.0,
+            startup_grace_secs=240.0, term_grace_secs=5.0,
+            backoff_base_secs=0.2, backoff_max_secs=1.0,
+            watch_poll_secs=0.2, reload_timeout_s=240.0,
+        ), seed=0,
+    )
+    fleet.start()
+    try:
+        _wait(lambda: fleet.healthy_count() == 2, timeout_s=240.0,
+              msg="2 real replicas healthy")
+        # 1) kill drill under 32-client closed loop
+        victim = fleet.replicas[0].pid
+
+        def killer():
+            time.sleep(0.5)
+            os.kill(victim, signal.SIGKILL)
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        summary = serve_bench.run_load(
+            fleet.router.url, concurrency=32, total_requests=256,
+            image_size=SIZE, pool=8, timeout_s=60.0,
+        )
+        kt.join()
+        assert summary["lost"] == 0, summary["lost_detail"]
+        _wait(lambda: fleet.healthy_count() == 2, timeout_s=240.0,
+              msg="killed replica restored")
+
+        # 2) truncated checkpoint: quarantined, never loaded
+        step_dir = watch / "50"
+        step_dir.mkdir()
+        import shutil
+        shutil.copy(path_b, step_dir / "encoder.npz")
+        write_manifest(str(watch), 50)
+        truncate_checkpoint(str(watch), 50)
+        _wait(lambda: any(e["event"] == "reload_quarantine"
+                          for e in fleet.incidents), timeout_s=30.0,
+              msg="truncated step quarantined")
+        assert all(r.deployed_step == -1 for r in fleet.replicas)
+
+        # 3) valid checkpoint: detected, verified, rolled — zero dropped
+        step_dir = watch / "60"
+        step_dir.mkdir()
+        shutil.copy(path_b, step_dir / "encoder.npz")
+        write_manifest(str(watch), 60)
+        result = {}
+
+        def load():
+            result.update(serve_bench.run_load(
+                fleet.router.url, concurrency=8, total_requests=128,
+                image_size=SIZE, pool=8, timeout_s=60.0,
+            ))
+
+        loader = threading.Thread(target=load)
+        loader.start()
+        _wait(lambda: all(r.deployed_step == 60 for r in fleet.replicas),
+              timeout_s=240.0, msg="reload rolled across the fleet")
+        loader.join(timeout=120.0)
+        assert result["lost"] == 0, result["lost_detail"]
+
+        # 4) bit-identity: the fleet now answers exactly like a fresh
+        # engine cold-started on checkpoint B
+        img = _imgs(1, seed=3)[0]
+        body = {"image_b64": base64.b64encode(img.tobytes()).decode(),
+                "shape": list(img.shape)}
+        status, resp = _post(fleet.router.url + "/v1/embed", body,
+                             timeout=60.0)
+        assert status == 200
+        cold = _engine_from(path_b)
+        cold.warmup()
+        assert np.array_equal(
+            np.asarray(resp["embedding"], np.float32),
+            cold.embed(img[None])[0],
+        )
+        events = [e["event"] for e in fleet.incidents]
+        assert "reload_done" in events
+    finally:
+        fleet.stop()

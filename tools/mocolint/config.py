@@ -184,21 +184,48 @@ DEFAULT_CONFIG = LintConfig(
         "R3": RuleScope(include=("moco_tpu/",),
                         exclude=("utils/logging.py", "utils/meters.py")),
         "R5": RuleScope(include=("moco_tpu/", "tools/supervise.py",
-                                 "tools/serve.py")),
+                                 "tools/serve.py", "tools/serve_fleet.py")),
+        # R6's historical scope is moco_tpu/serve/ (fleet.py rides along);
+        # the fleet CLI lives in tools/ and must honor the same boundary
+        "R6": RuleScope(include=("moco_tpu/serve/", "tools/serve_fleet.py")),
         "R8": RuleScope(include=STEP_BUILDER_MODULES),
         "R9": RuleScope(include=BIT_IDENTITY_MODULES),
     },
     boundaries=(
         _SERVE_BOUNDARY,
+        # ISSUE 10: the fleet CLI is serve-side code outside moco_tpu/serve/
+        Boundary(
+            name="fleet-cli-train-free",
+            rule_id="R6",
+            scope=("tools/serve_fleet.py",),
+            forbid=SERVE_FORBIDDEN,
+            why=("the fleet front end routes traffic for N serving "
+                 "processes; a train dependency here couples the whole "
+                 "fleet's availability to the training stack"),
+        ),
         Boundary(
             name="serve-train-free-transitive",
             rule_id="R11",
-            scope=("moco_tpu/serve/",),
+            scope=("moco_tpu/serve/", "tools/serve_fleet.py"),
             forbid=SERVE_FORBIDDEN,
             transitive=True,
             why=("an import CHAIN from serve/ to the train stack defeats "
                  "R6 exactly as a direct import would — the optimizer "
                  "lands in the serving process either way"),
+        ),
+        Boundary(
+            name="fleet-stdlib-only",
+            rule_id="R11",
+            scope=("moco_tpu/serve/fleet.py", "tools/serve_fleet.py"),
+            stdlib_only=True,
+            allow_prefixes=("moco_tpu",),
+            transitive=True,
+            why=("the fleet supervisor+router is the LAST process standing "
+                 "when replicas die — the supervisor contract (PR 4): it "
+                 "must never import jax/numpy, directly or through a "
+                 "moco_tpu module, so a poisoned compile cache or an OOM'd "
+                 "runtime cannot take the routing tier down with the "
+                 "replicas"),
         ),
         Boundary(
             name="supervisor-stdlib-only",

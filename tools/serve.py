@@ -7,7 +7,9 @@
 Loads the checkpoint's encoder through the shared surgery loader
 (`checkpoint.load_for_inference` — both dialects), pre-compiles the
 bucket ladder, and mounts the stdlib front end (moco_tpu/serve/http.py):
-POST /v1/embed, POST /v1/knn (with --knn-bank), GET /healthz, /stats.
+POST /v1/embed, POST /v1/knn (with --knn-bank), POST /admin/reload (hot
+weight swap — the fleet supervisor's roll target, ISSUE 10),
+GET /healthz, /stats.
 
 SIGTERM/SIGINT drains gracefully — in-flight requests complete, new work
 gets a structured 503 `draining` — via the resilience/preemption.py
@@ -47,13 +49,20 @@ def build_service(config: ServeConfig):
 
     from moco_tpu.serve import EmbeddingEngine, EmbedService
 
-    engine = EmbeddingEngine.from_checkpoint(
-        config.pretrained,
-        config.arch,
-        image_size=config.image_size,
-        cifar_stem=config.cifar_stem,
-        buckets=config.buckets,
-    )
+    def engine_factory(path: str) -> "EmbeddingEngine":
+        # hot reload (ISSUE 10): POST /admin/reload builds the new engine
+        # through the SAME loader + config as the boot-time one, so a
+        # reloaded replica is indistinguishable from a cold start on that
+        # checkpoint (bit-identity test-pinned)
+        return EmbeddingEngine.from_checkpoint(
+            path,
+            config.arch,
+            image_size=config.image_size,
+            cifar_stem=config.cifar_stem,
+            buckets=config.buckets,
+        )
+
+    engine = engine_factory(config.pretrained)
     registry = None
     tracer = None
     if config.telemetry_dir:
@@ -98,6 +107,7 @@ def build_service(config: ServeConfig):
         knn_k=config.knn_k,
         knn_temperature=config.knn_temperature,
     )
+    service.set_engine_factory(engine_factory)
     return service, registry
 
 

@@ -9,10 +9,23 @@ back (closed loop — a new request only after the previous one resolved),
 so the offered load is exactly the in-flight concurrency the
 micro-batcher coalesces. EVERY request must end in a result or a
 STRUCTURED rejection (overloaded / deadline_exceeded / draining JSON
-body); anything else — connection error, unstructured 5xx — counts as
-LOST and fails the run. Prints one BENCH-style JSON record: latency
-p50/p95/p99, throughput at the fixed concurrency, shed counts, and the
-server's own /stats fold (mean batch occupancy, compile-bucket ladder).
+body — and the fleet router's no_healthy_backend / upstream_* codes);
+anything else — connection error, unstructured 5xx — counts as LOST and
+fails the run. Prints one BENCH-style JSON record: latency p50/p95/p99,
+throughput at the fixed concurrency, shed counts, and the server's own
+/stats fold (mean batch occupancy, compile-bucket ladder).
+
+Fleet mode (ISSUE 10):
+
+    python tools/serve_bench.py --fleet 1,2,4 [--kill-drill] -- \
+        python tools/serve.py --pretrained encoder.npz --arch resnet50
+
+spins up `tools/serve_fleet.py` at each replica count (everything after
+`--` is one replica's base command), drives the SAME closed loop through
+the router, and reports rps/p99/lost per count. `--kill-drill` SIGKILLs
+one replica mid-load (pid from the router's /stats) — the zero-lost
+contract must hold THROUGH the kill: the router's single-retry absorbs
+in-flight failures.
 
 Pure stdlib + numpy: runs anywhere the server is reachable, no jax.
 """
@@ -23,7 +36,11 @@ import argparse
 import base64
 import http.client
 import json
+import os
+import signal
+import subprocess
 import sys
+import tempfile
 import threading
 import time
 import urllib.parse
@@ -31,7 +48,12 @@ import urllib.request
 
 import numpy as np
 
-STRUCTURED_REJECTIONS = ("overloaded", "deadline_exceeded", "draining")
+# the replica's own shed codes + the fleet router's (ISSUE 10): all are
+# ANSWERS — a client told to back off was served a decision, not dropped
+STRUCTURED_REJECTIONS = (
+    "overloaded", "deadline_exceeded", "draining",
+    "no_healthy_backend", "upstream_timeout", "upstream_error",
+)
 
 
 def _percentile(values: list[float], q: float) -> float:
@@ -203,10 +225,152 @@ def run_load(
     }
 
 
+# ---------------------------------------------------------------------------
+# fleet mode (ISSUE 10): closed-loop load vs replica count
+# ---------------------------------------------------------------------------
+
+
+def _wait_fleet_ready(proc, want_replicas: int, boot_timeout_s: float):
+    """Parse the fleet's announcement line, then poll /healthz until all
+    replicas are in rotation. Returns the router url."""
+    url = None
+    deadline = time.monotonic() + boot_timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError("fleet exited before announcing its url")
+        if "fleet serving on http://" in line:
+            url = "http://" + line.split("http://")[1].split()[0].rstrip("/")
+            break
+    if url is None:
+        raise RuntimeError("fleet never announced its url")
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/healthz", timeout=2.0) as r:
+                body = json.loads(r.read())
+        except (OSError, ValueError):
+            body = {}
+        if body.get("healthy", 0) >= want_replicas:
+            return url
+        time.sleep(0.2)
+    raise RuntimeError(
+        f"fleet never reached {want_replicas} healthy replicas"
+    )
+
+
+def _kill_one_replica(url: str) -> int | None:
+    """SIGKILL one healthy replica, pid from the router's /stats (the
+    drill a production orchestrator performs by accident)."""
+    stats = fetch_stats(url)
+    if not stats:
+        return None
+    for rep in stats.get("replicas", []):
+        if rep.get("healthy") and rep.get("pid"):
+            os.kill(int(rep["pid"]), signal.SIGKILL)
+            return int(rep["pid"])
+    return None
+
+
+def run_fleet_bench(
+    replica_cmd: list,
+    counts=(1, 2, 4),
+    *,
+    concurrency: int = 32,
+    total_requests: int = 512,
+    image_size: int = 224,
+    pool: int = 16,
+    timeout_s: float = 30.0,
+    deadline_ms: float = 0.0,
+    endpoint: str = "/v1/embed",
+    seed: int = 0,
+    kill_drill: bool = False,
+    kill_after_s: float = 1.0,
+    boot_timeout_s: float = 240.0,
+    fleet_args: list | None = None,
+    env: dict | None = None,
+) -> list[dict]:
+    """One closed-loop run per replica count against a freshly spawned
+    `tools/serve_fleet.py`; returns one row per count. With `kill_drill`
+    (counts > 1 only) one replica is SIGKILLed `kill_after_s` into the
+    load — `lost` must stay 0 through it (the acceptance contract)."""
+    import shutil
+
+    fleet_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "serve_fleet.py")
+    rows = []
+    for n in counts:
+        tdir = tempfile.mkdtemp(prefix=f"fleet_bench_{n}r_")
+        argv = [
+            sys.executable, "-u", fleet_py,
+            "--replicas", str(n), "--port", "0", "--base-port", "0",
+            "--telemetry-dir", tdir,
+            "--probe-secs", "0.2", "--probe-timeout-s", "2.0",
+            "--health-stale-secs", "10",
+            "--startup-grace-secs", str(boot_timeout_s),
+            "--backoff-base-secs", "0.1",
+        ] + list(fleet_args or []) + ["--"] + list(replica_cmd)
+        proc = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+        )
+        row: dict = {"replicas": n}
+        killer = None
+        try:
+            url = _wait_fleet_ready(proc, n, boot_timeout_s)
+            drill = kill_drill and n > 1
+            killed = {}
+            if drill:
+                def _later():
+                    time.sleep(kill_after_s)
+                    killed["pid"] = _kill_one_replica(url)
+
+                killer = threading.Thread(target=_later, daemon=True)
+                killer.start()
+            summary = run_load(
+                url, concurrency=concurrency,
+                total_requests=total_requests, image_size=image_size,
+                pool=pool, timeout_s=timeout_s, deadline_ms=deadline_ms,
+                endpoint=endpoint, seed=seed,
+            )
+            if killer is not None:
+                killer.join(timeout=10.0)
+            row.update({
+                "throughput_rps": summary["throughput_rps"],
+                "latency_ms": summary["latency_ms"],
+                "ok": summary["ok"],
+                "shed": summary["shed"],
+                "lost": summary["lost"],
+                "lost_detail": summary["lost_detail"],
+            })
+            if drill:
+                row["killed_pid"] = killed.get("pid")
+            stats = fetch_stats(url)
+            if stats:
+                row["router"] = stats.get("router")
+        except (RuntimeError, OSError) as e:
+            row["error"] = f"{type(e).__name__}: {e}"
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=30.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+            if "error" in row:
+                # keep the telemetry for a post-mortem, and say where
+                row["telemetry_dir"] = tdir
+            else:
+                shutil.rmtree(tdir, ignore_errors=True)
+        rows.append(row)
+    return rows
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
-    parser.add_argument("--url", required=True,
-                        help="server base url, e.g. http://127.0.0.1:8080")
+    parser.add_argument("--url",
+                        help="server base url, e.g. http://127.0.0.1:8080 "
+                             "(required unless --fleet)")
     parser.add_argument("--concurrency", type=int, default=32)
     parser.add_argument("--requests", type=int, default=512)
     parser.add_argument("--image-size", type=int, default=224)
@@ -219,8 +383,55 @@ def main(argv=None) -> int:
     parser.add_argument("--endpoint", default="/v1/embed",
                         choices=["/v1/embed", "/v1/knn"])
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--fleet", default="",
+                        help="fleet mode: comma-separated replica counts "
+                             "(e.g. 1,2,4); everything after -- is one "
+                             "replica's base command")
+    parser.add_argument("--kill-drill", action="store_true",
+                        help="fleet mode: SIGKILL one replica mid-load "
+                             "at counts > 1 (lost must stay 0)")
+    parser.add_argument("--kill-after-s", type=float, default=1.0)
+    parser.add_argument("replica_cmd", nargs=argparse.REMAINDER,
+                        help="fleet mode: -- then one replica's command")
     args = parser.parse_args(argv)
 
+    if args.fleet:
+        counts = tuple(int(c) for c in args.fleet.split(",") if c.strip())
+        cmd = args.replica_cmd
+        if cmd and cmd[0] == "--":
+            cmd = cmd[1:]
+        if not counts or not cmd:
+            parser.error("--fleet needs counts AND `-- <replica command>`")
+        rows = run_fleet_bench(
+            cmd, counts,
+            concurrency=args.concurrency,
+            total_requests=args.requests,
+            image_size=args.image_size,
+            pool=args.pool,
+            timeout_s=args.timeout_s,
+            deadline_ms=args.deadline_ms,
+            endpoint=args.endpoint,
+            seed=args.seed,
+            kill_drill=args.kill_drill,
+            kill_after_s=args.kill_after_s,
+        )
+        complete = [r for r in rows if "error" not in r]
+        best = max((r["throughput_rps"] for r in complete), default=0.0)
+        record = {
+            "metric": "serve_fleet_rps",
+            "value": best,
+            "unit": "rps",
+            "vs_baseline": 0.0,
+            "detail": {"rows": rows, "kill_drill": args.kill_drill,
+                       "concurrency": args.concurrency,
+                       "requests": args.requests},
+        }
+        print(json.dumps(record))
+        lost = sum(r.get("lost", 0) for r in rows)
+        return 1 if (lost or len(complete) < len(rows)) else 0
+
+    if not args.url:
+        parser.error("--url is required (or use --fleet)")
     summary = run_load(
         args.url,
         concurrency=args.concurrency,

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Run a replicated serve fleet: N serve.py replicas behind one router
+(ISSUE 10).
+
+    python tools/serve_fleet.py --replicas 2 --port 8080 \
+        --telemetry-dir runs/fleet --watch-dir runs/export -- \
+        python tools/serve.py --pretrained runs/encoder.npz --arch resnet50
+
+Everything after `--` is ONE replica's base command; the fleet appends
+`--port <p>` and `--telemetry-dir <dir>/replica<i>` per replica (and,
+after a hot reload, `--pretrained <newest verified payload>` so a
+relaunched replica boots on the deployed weights). The front-end router
+serves `POST /v1/embed` / `POST /v1/knn` (health-routed least-outstanding
+with single-retry), `GET /healthz`, `GET /stats`; replica `/admin/*`
+stays on the replicas' own ports, never proxied.
+
+Signals: SIGTERM/SIGINT drain the whole fleet (replicas finish accepted
+work) and exit 0; a second signal exits immediately. SIGHUP triggers a
+drain-aware ROLLING restart that never takes capacity below N−1.
+
+`--watch-dir` arms the hot-reload watcher: new integrity-manifested
+steps are verified, corrupt ones quarantined to `.quarantine/`, and
+verified ones rolled across the fleet via each replica's
+`POST /admin/reload` — zero dropped requests.
+
+`--chaos`/`--chaos-replica` install a drill fault (e.g.
+`kill_at_request=200`, `wedge_at_request=200`) on ONE replica via
+MOCO_TPU_CHAOS, with fire-once state persisted per replica dir so the
+restarted replica doesn't re-fire the drill.
+
+Pure stdlib — this process must outlive replicas that OOM or segfault
+(mocolint R11 pins the import diet, transitively).
+
+Exit codes (README table): 0 clean drain · 45 bad flags · 48 could not
+bind the router host:port · 1 every replica abandoned.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from moco_tpu.resilience.exitcodes import (  # noqa: E402
+    EXIT_CONFIG_ERROR,
+    EXIT_FLEET_BIND,
+    EXIT_OK,
+)
+from moco_tpu.serve.fleet import (  # noqa: E402
+    FleetLaunchError,
+    FleetPolicy,
+    FleetSupervisor,
+)
+from moco_tpu.utils.logging import info  # noqa: E402
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description=__doc__.splitlines()[1],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="front-end router port (0 = ephemeral, printed)")
+    p.add_argument("--base-port", type=int, default=0,
+                   help="replica i binds base-port+i; 0 picks free "
+                        "ephemeral ports")
+    p.add_argument("--telemetry-dir", required=True,
+                   help="fleet events.jsonl + per-replica dirs live here")
+    p.add_argument("--watch-dir", default="",
+                   help="checkpoint export dir to watch for hot reloads "
+                        "(PR 1 step layout + integrity manifests)")
+    p.add_argument("--probe-secs", type=float, default=1.0)
+    p.add_argument("--probe-timeout-s", type=float, default=2.0)
+    p.add_argument("--health-stale-secs", type=float, default=10.0,
+                   help="kill a replica whose newest probe answer is "
+                        "older than this (accepting-but-not-answering "
+                        "wedge)")
+    p.add_argument("--startup-grace-secs", type=float, default=300.0,
+                   help="launch -> first healthy probe allowance (cold "
+                        "jax import + bucket-ladder compile)")
+    p.add_argument("--term-grace-secs", type=float, default=15.0)
+    p.add_argument("--max-restarts", type=int, default=5,
+                   help="consecutive never-healthy deaths per replica "
+                        "before abandoning it (a healthy life refunds)")
+    p.add_argument("--backoff-base-secs", type=float, default=0.5)
+    p.add_argument("--backoff-max-secs", type=float, default=30.0)
+    p.add_argument("--backoff-jitter", type=float, default=0.2)
+    p.add_argument("--request-timeout-s", type=float, default=30.0,
+                   help="router default per-request deadline (a body "
+                        "deadline_ms wins)")
+    p.add_argument("--watch-poll-secs", type=float, default=1.0)
+    p.add_argument("--reload-timeout-s", type=float, default=300.0)
+    p.add_argument("--chaos", default="",
+                   help="drill fault spec for ONE replica, e.g. "
+                        "kill_at_request=200 (see resilience/chaos.py)")
+    p.add_argument("--chaos-replica", type=int, default=0,
+                   help="which replica gets --chaos")
+    p.add_argument("replica_cmd", nargs=argparse.REMAINDER,
+                   help="-- then one replica's base command")
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    cmd = args.replica_cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        info("config error: no replica command given (append `-- python "
+             "tools/serve.py --pretrained ...`)")
+        return EXIT_CONFIG_ERROR
+    if args.replicas < 1:
+        info(f"config error: --replicas must be >= 1, got {args.replicas}")
+        return EXIT_CONFIG_ERROR
+
+    def child_argv(index: int, port: int, telemetry_dir: str,
+                   pretrained: str | None) -> list:
+        out = list(cmd) + ["--port", str(port),
+                           "--telemetry-dir", telemetry_dir]
+        if pretrained:
+            # argparse last-wins: this overrides the base command's
+            # --pretrained so a relaunch boots on the deployed weights
+            out += ["--pretrained", pretrained]
+        return out
+
+    replica_env = {}
+    if args.chaos:
+        replica_env[args.chaos_replica] = {
+            "MOCO_TPU_CHAOS": args.chaos,
+            "MOCO_TPU_CHAOS_STATE": os.path.join(
+                args.telemetry_dir, f"replica{args.chaos_replica}",
+                "chaos_state",
+            ),
+        }
+
+    policy = FleetPolicy(
+        probe_secs=args.probe_secs,
+        probe_timeout_s=args.probe_timeout_s,
+        health_stale_secs=args.health_stale_secs,
+        startup_grace_secs=args.startup_grace_secs,
+        term_grace_secs=args.term_grace_secs,
+        max_restarts=args.max_restarts,
+        backoff_base_secs=args.backoff_base_secs,
+        backoff_max_secs=args.backoff_max_secs,
+        backoff_jitter=args.backoff_jitter,
+        request_timeout_s=args.request_timeout_s,
+        watch_poll_secs=args.watch_poll_secs,
+        reload_timeout_s=args.reload_timeout_s,
+    )
+    fleet = FleetSupervisor(
+        child_argv,
+        replicas=args.replicas,
+        telemetry_dir=args.telemetry_dir,
+        host=args.host,
+        router_port=args.port,
+        base_port=args.base_port,
+        policy=policy,
+        watch_dir=args.watch_dir,
+        replica_env=replica_env,
+    )
+    try:
+        fleet.start()
+    except FleetLaunchError as e:
+        # the replica COMMAND can't spawn: the same argv can never
+        # succeed — config error, NOT the reschedule-semantics 48
+        info(f"config error: {e}")
+        return EXIT_CONFIG_ERROR
+    except OSError as e:
+        info(f"cannot bind the fleet router {args.host}:{args.port}: {e}")
+        return EXIT_FLEET_BIND
+
+    if hasattr(signal, "SIGHUP"):
+        signal.signal(
+            signal.SIGHUP,
+            lambda signum, frame: fleet.request_rolling_restart(),
+        )
+
+    from moco_tpu.resilience.preemption import PreemptionHandler
+
+    with PreemptionHandler() as pre:
+        info(
+            f"fleet serving on {fleet.router.url} "
+            f"({args.replicas} replicas on ports "
+            f"{[r.port for r in fleet.replicas]}; SIGHUP = rolling "
+            f"restart)"
+        )
+        while not pre.triggered and not fleet.failed:
+            time.sleep(0.2)
+    fleet.stop()
+    if fleet.failed:
+        info("fleet failed: every replica abandoned")
+        return 1
+    info("fleet drained cleanly")
+    return EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

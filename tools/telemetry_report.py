@@ -4,6 +4,7 @@
     python tools/telemetry_report.py <run_dir>/telemetry/events.jsonl
     python tools/telemetry_report.py events.jsonl --json
     python tools/telemetry_report.py events.jsonl --follow
+    python tools/telemetry_report.py <fleet_telemetry_dir>   # ISSUE 10
 
 Renders, from the schema-versioned record stream the driver writes
 (moco_tpu/telemetry/registry.py):
@@ -25,6 +26,15 @@ Renders, from the schema-versioned record stream the driver writes
     count and mean bucket occupancy, embedding-cache hit rate — from the
     cumulative `kind: "serve"` snapshots the embedding service emits
     (the LAST snapshot summarizes the run)
+  - serve fleet (ISSUE 10): pass the FLEET telemetry DIRECTORY (the
+    `--telemetry-dir` of tools/serve_fleet.py) and the report merges the
+    fleet's own events.jsonl with every `replica*/events.jsonl` under
+    it: per-replica launch/restart/kill/ejection counts and death
+    classifications from the `kind: "fleet"` records, router totals +
+    shed rate from the last `router_stats` record, reload history
+    (detected / rolled / quarantined), and a per-replica fold of each
+    replica's own last serve snapshot (the single-file `serve:` section
+    assumes exactly one server)
   - pod-record count and worst cross-host step-time spread
 
 `--follow` (ISSUE 8 satellite) is the live-tail mode: poll the file and
@@ -79,6 +89,40 @@ def _percentile(values: list[float], q: float) -> float:
     return ordered[int(rank)]
 
 
+def expand_events_arg(path: str) -> list[tuple[str, str]]:
+    """`(label, events_path)` pairs for one CLI argument. A FILE is
+    itself (label ""); a DIRECTORY is a fleet telemetry dir (ISSUE 10):
+    its own events.jsonl plus every `replica*/events.jsonl` under it."""
+    if not os.path.isdir(path):
+        return [("", path)]
+    pairs = []
+    own = os.path.join(path, "events.jsonl")
+    if os.path.exists(own):
+        pairs.append(("fleet", own))
+    for name in sorted(os.listdir(path)):
+        sub = os.path.join(path, name, "events.jsonl")
+        if name.startswith("replica") and os.path.exists(sub):
+            pairs.append((name, sub))
+    if not pairs:
+        raise OSError(f"no events.jsonl under directory {path}")
+    return pairs
+
+
+def load_events_multi(pairs: list[tuple[str, str]]) -> tuple[list[dict], int]:
+    """Merge several events files; each record is tagged with its source
+    label under `_src` (empty for the single-file case) so per-replica
+    folds can group without re-reading."""
+    records, skipped = [], 0
+    for label, path in pairs:
+        recs, skip = load_events(path)
+        if label:
+            for r in recs:
+                r["_src"] = label
+        records.extend(recs)
+        skipped += skip
+    return records, skipped
+
+
 def summarize(records: list[dict], skipped: int = 0) -> dict:
     """Fold parsed records into one summary dict (the --json payload)."""
     steps = [r for r in records if r.get("kind") == "step"]
@@ -88,6 +132,7 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
     run_ends = [r for r in records if r.get("kind") == "run_end"]
     supervisor = [r for r in records if r.get("kind") == "supervisor"]
     serves = [r for r in records if r.get("kind") == "serve"]
+    fleet = [r for r in records if r.get("kind") == "fleet"]
 
     step_s = [r["step_s"] for r in steps if "step_s" in r]
     data_s = [r["data_s"] for r in steps if "data_s" in r]
@@ -222,8 +267,13 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
         if budgets:
             sup["budget_left"] = budgets[-1]
         summary["supervisor"] = sup
-    if serves:
-        # snapshots are cumulative; the last one summarizes the run
+    if serves and not fleet:
+        # snapshots are cumulative; the last one summarizes the run.
+        # With FLEET records present this section is suppressed: N
+        # replicas each write their own cumulative stream, and "the last
+        # merged snapshot" would present one arbitrary replica's
+        # counters as the run's — the fleet section carries the honest
+        # per-replica fold + served_total instead.
         last = serves[-1]
         summary["serve"] = {
             k: last[k]
@@ -234,9 +284,94 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
             if k in last
         }
         summary["serve"]["snapshots"] = len(serves)
+    if fleet:
+        summary["fleet"] = _summarize_fleet(fleet, serves)
     if run_ends:
         summary["run_end"] = run_ends[-1]
     return summary
+
+
+def _summarize_fleet(fleet: list[dict], serves: list[dict]) -> dict:
+    """Fold the `kind: "fleet"` lifecycle stream (ISSUE 10) + each
+    replica's own serve snapshots (grouped by the `_src` tag the
+    multi-dir loader stamps) into one section."""
+    by_event: dict[str, int] = {}
+    per_replica: dict[int, dict] = {}
+    for r in fleet:
+        event = str(r.get("event", "unknown"))
+        by_event[event] = by_event.get(event, 0) + 1
+        idx = r.get("replica")
+        if idx is None:
+            continue
+        rep = per_replica.setdefault(int(idx), {
+            "launches": 0, "restarts": 0, "kills": 0, "ejections": 0,
+            "readmissions": 0, "reloads": 0, "classifications": [],
+        })
+        if event == "launch":
+            rep["launches"] += 1
+            rep["restarts"] = max(rep["launches"] - 1, 0)
+        elif event == "kill" and r.get("phase") != "sigkill":
+            rep["kills"] += 1  # one kill decision, not one per signal
+        elif event == "eject":
+            rep["ejections"] += 1
+        elif event == "readmit":
+            rep["readmissions"] += 1
+        elif event == "reload_replica" and r.get("status") == "ok":
+            rep["reloads"] += 1
+        elif event == "replica_exit":
+            rep["classifications"].append(str(r.get("classification", "?")))
+    sec: dict = {"events": by_event, "replicas": per_replica}
+    starts = [r for r in fleet if r.get("event") == "fleet_start"]
+    if starts:
+        sec["size"] = starts[-1].get("replicas")
+    stats = [r for r in fleet if r.get("event") == "router_stats"]
+    if stats:
+        last = stats[-1]
+        router = {
+            k: last[k]
+            for k in ("requests", "ok", "retries", "retry_ok",
+                      "shed_no_backend", "upstream_timeout",
+                      "upstream_error", "passthrough_non_200", "healthy")
+            if k in last
+        }
+        reqs = router.get("requests", 0)
+        shed = (router.get("shed_no_backend", 0)
+                + router.get("upstream_timeout", 0)
+                + router.get("upstream_error", 0))
+        router["shed_rate"] = round(shed / reqs, 4) if reqs else 0.0
+        sec["router"] = router
+    reload_events = ("reload_detected", "reload_replica", "reload_done",
+                     "reload_failed", "reload_quarantine",
+                     "reload_bad_layout")
+    history = [
+        {k: r[k] for k in ("event", "step", "replica", "reason", "status",
+                           "path", "t") if k in r}
+        for r in fleet if r.get("event") in reload_events
+    ]
+    if history:
+        sec["reload_history"] = history[-32:]
+    # each replica's OWN last serve snapshot (cumulative): the single-file
+    # `serve:` section can't tell N servers apart
+    by_src: dict[str, dict] = {}
+    for s in serves:
+        src = s.get("_src")
+        if src:
+            by_src[src] = s
+    if by_src:
+        sec["serve_by_replica"] = {
+            src: {
+                k: snap[k]
+                for k in ("requests", "served", "shed_overload",
+                          "shed_deadline", "batches", "occupancy_mean",
+                          "reloads")
+                if k in snap
+            }
+            for src, snap in sorted(by_src.items())
+        }
+        sec["served_total"] = sum(
+            s.get("served", 0) for s in by_src.values()
+        )
+    return sec
 
 
 def fold_programs(summary: dict, inventory: dict) -> dict:
@@ -433,6 +568,48 @@ def render(summary: dict) -> str:
                 f"({cache.get('hits', 0)} hit / {cache.get('misses', 0)} "
                 f"miss, {cache.get('entries', 0)} entries)"
             )
+    flt = summary.get("fleet")
+    if flt:
+        router = flt.get("router", {})
+        lines.append(
+            f"fleet: {flt.get('size', len(flt.get('replicas', {})))} "
+            f"replica(s) · router {router.get('requests', 0)} requests "
+            f"({router.get('retries', 0)} retried, shed rate "
+            f"{100 * router.get('shed_rate', 0):.2f}%)"
+        )
+        for idx, rep in sorted(flt.get("replicas", {}).items()):
+            counts: dict[str, int] = {}
+            for c in rep["classifications"]:
+                counts[c] = counts.get(c, 0) + 1
+            deaths = ", ".join(f"{k}×{v}" for k, v in sorted(counts.items()))
+            lines.append(
+                f"  replica {idx}: {rep['launches']} launch(es), "
+                f"{rep['restarts']} restart(s), {rep['kills']} kill(s), "
+                f"{rep['ejections']} ejection(s)"
+                + (f" — deaths: {deaths}" if deaths else "")
+            )
+        srv_by = flt.get("serve_by_replica")
+        if srv_by:
+            per = " · ".join(
+                f"{src} {snap.get('served', 0)}/{snap.get('requests', 0)}"
+                for src, snap in srv_by.items()
+            )
+            lines.append(
+                f"  served (per replica, served/requests): {per} — "
+                f"total {flt.get('served_total', 0)}"
+            )
+        history = flt.get("reload_history", [])
+        done = [h for h in history if h["event"] == "reload_done"]
+        quarantined = [h for h in history
+                       if h["event"] == "reload_quarantine"]
+        if history:
+            lines.append(
+                f"  reloads: {len(done)} deployed "
+                f"({', '.join(str(h.get('step')) for h in done[-6:])})"
+                + (f" · {len(quarantined)} quarantined "
+                   f"({', '.join(str(h.get('step')) for h in quarantined[-6:])})"
+                   if quarantined else "")
+            )
     progs = summary.get("programs")
     if progs:
         fams = ", ".join(f"{k}×{v}" for k, v in
@@ -508,6 +685,12 @@ def render_record(rec: dict) -> str | None:
             if k not in ("v", "t", "kind", "event", "run_id", "trace_id")
         )
         return f"supervisor: {rec.get('event', '?')} {detail}".rstrip()
+    if kind == "fleet":
+        detail = " ".join(
+            f"{k}={v}" for k, v in rec.items()
+            if k not in ("v", "t", "kind", "event", "run_id", "trace_id")
+        )
+        return f"fleet: {rec.get('event', '?')} {detail}".rstrip()
     if kind == "serve":
         lat = rec.get("latency_ms") or {}
         return (
@@ -577,7 +760,10 @@ def follow(path: str, out=None, poll_secs: float = 0.5, stop=None,
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
-    parser.add_argument("events", help="path to telemetry events.jsonl")
+    parser.add_argument("events",
+                        help="path to telemetry events.jsonl, or a fleet "
+                             "telemetry DIRECTORY (merges its "
+                             "events.jsonl + replica*/events.jsonl)")
     parser.add_argument("--json", action="store_true",
                         help="emit one machine-readable summary object")
     parser.add_argument("--follow", action="store_true",
@@ -591,13 +777,16 @@ def main(argv=None) -> int:
                              "cross-check)")
     args = parser.parse_args(argv)
     if args.follow:
+        path = args.events
+        if os.path.isdir(path):  # fleet dir: follow the fleet's own stream
+            path = os.path.join(path, "events.jsonl")
         try:
-            follow(args.events, poll_secs=args.poll_secs)
+            follow(path, poll_secs=args.poll_secs)
         except KeyboardInterrupt:
             pass
         return 0
     try:
-        records, skipped = load_events(args.events)
+        records, skipped = load_events_multi(expand_events_arg(args.events))
     except OSError as e:
         print(f"cannot read {args.events}: {e}", file=sys.stderr)
         return 2
