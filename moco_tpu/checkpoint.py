@@ -89,21 +89,30 @@ def _position_path(directory: str, step: int) -> str:
 
 
 def write_position(directory: str, step: int,
-                   position: tuple[int, int] | None) -> None:
+                   position: tuple[int, int] | None,
+                   devices: int | None = None) -> None:
     """Record the data-stream position `(epoch, next_batch_index)` the run
     will be at when restored from `step`. `step // steps_per_epoch`
     arithmetic recovers it ONLY while steps and batches are aligned — a NaN
     rollback's data-window skip breaks that permanently, after which a
     resume placed by arithmetic silently replays consumed batches. Written
     atomically on process 0; absent/corrupt sidecars fall back to the
-    arithmetic."""
+    arithmetic.
+
+    `devices` (the mesh size the state was saved under, ISSUE 11) rides
+    the same sidecar so the jax-free supervisor can flag a `mesh_change`
+    at relaunch preflight (resize.read_recorded_devices) instead of the
+    restore shim discovering it mid-restore."""
     if position is None or jax.process_index() != 0:
         return
     path = _position_path(directory, step)
     os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {"epoch": int(position[0]), "batch": int(position[1])}
+    if devices is not None:
+        payload["devices"] = int(devices)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({"epoch": int(position[0]), "batch": int(position[1])}, f)
+        json.dump(payload, f)
     os.replace(tmp, path)
 
 
@@ -140,7 +149,7 @@ def _prune_sidecars(mgr: ocp.CheckpointManager) -> None:
 
 def save_checkpoint(
     mgr: ocp.CheckpointManager, state: TrainState, step: int, wait: bool = True,
-    position: tuple[int, int] | None = None,
+    position: tuple[int, int] | None = None, devices: int | None = None,
 ) -> None:
     """Save `state` at `step`. With `wait=True` (default), block until the
     save finalizes and record an integrity manifest sidecar (process 0) so a
@@ -158,7 +167,7 @@ def save_checkpoint(
     import orbax.checkpoint as ocp
 
     finalize_checkpoints(mgr)
-    write_position(str(mgr.directory), step, position)
+    write_position(str(mgr.directory), step, position, devices=devices)
     mgr.save(step, args=ocp.args.StandardSave(_unkey(state)))
     if wait:
         mgr.wait_until_finished()
@@ -202,38 +211,20 @@ def _restore_step(
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
 
         target = jax.tree.map(to_abstract, target)
-    try:
-        restored = mgr.restore(step, args=ocp.args.StandardRestore(target))
-    except Exception:
-        # dialect shim (TRAIN_STATE_DIALECTS): the target's `gradsync`
-        # subtree and the checkpoint's disagree whenever the checkpoint is
-        # dialect 1 (no such key on disk), was written under a different
-        # grad_sync mode (present vs empty), or on a different mesh size
-        # (leading [n_dev] axis mismatch). Retry with a target whose
+    def _sig(tree):
+        return [
+            (jax.tree_util.keystr(p), tuple(leaf.shape))
+            for p, leaf in jax.tree_util.tree_leaves_with_path(tree)
+        ]
+
+    def _restore_fresh_gradsync(md_gs):
+        # dialect shim (TRAIN_STATE_DIALECTS): restore with a target whose
         # gradsync subtree is rebuilt FROM THE CHECKPOINT'S OWN metadata —
-        # structurally exact, so a healthy checkpoint restores — then throw
-        # the on-disk accumulators away and keep the caller's fresh ones
-        # (zeros: the convergence-safe cold-start state). A retry failure
-        # is genuine corruption and propagates to the walk-back.
+        # structurally exact, so a healthy checkpoint restores — then
+        # throw the on-disk accumulators away and keep the caller's fresh
+        # ones (zeros: the convergence-safe cold-start state)
         import dataclasses
 
-        if not hasattr(abstract_state, "gradsync"):
-            raise
-        md = mgr.item_metadata(step)
-        md_gs = md.get("gradsync") if isinstance(md, dict) else None
-
-        def _sig(tree):
-            return [
-                (jax.tree_util.keystr(p), tuple(leaf.shape))
-                for p, leaf in jax.tree_util.tree_leaves_with_path(tree)
-            ]
-
-        if _sig(md_gs) == _sig(getattr(target, "gradsync")):
-            # the checkpoint's gradsync subtree matches the target's — the
-            # failure is NOT a dialect/mode/mesh mismatch (transient read,
-            # real corruption): re-raise rather than silently zeroing valid
-            # on-disk accumulators under a misleading dialect event
-            raise
         stripped = {
             f.name: getattr(target, f.name)
             for f in dataclasses.fields(target)
@@ -258,9 +249,77 @@ def _restore_step(
             "size) — restored without them; error-feedback/momentum state "
             "restarts from zeros",
         )
-        restored = type(abstract_state)(
+        return type(abstract_state)(
             **{k: v for k, v in restored_dict.items() if k != "gradsync"},
             gradsync=abstract_state.gradsync)
+
+    def _gradsync_md(_require=False):
+        # (gradsync metadata, metadata-readable) — `item_metadata` yields
+        # None on a manager that has not yet resolved its item handler
+        # (a FRESH manager before any save/restore call: every relaunch's
+        # `--resume auto`); only a restore attempt registers it. A None
+        # here therefore means "unknown", never "no gradsync on disk" —
+        # treating it as absent once stripped a key the checkpoint HAS
+        # and crash-looped the relaunch on a Dict-key-mismatch.
+        try:
+            md = mgr.item_metadata(step)
+        except Exception:
+            if _require:
+                raise
+            return None, False
+        if not isinstance(md, dict):
+            return None, False
+        return md.get("gradsync"), True
+
+    # the gradsync signature mismatch is checked UP FRONT against the
+    # checkpoint's own metadata (when readable), not inferred from a
+    # restore failure: on this orbax a mesh-size mismatch ([4, ...]
+    # accumulators into a [2, ...] target — the elastic 4→2 relaunch)
+    # does NOT fail, it silently SLICES — which would hand the resized
+    # run a truncated per-device error-feedback state instead of the
+    # fresh-zero cold start the dialect contract promises.
+    target_sig = (_sig(getattr(target, "gradsync"))
+                  if hasattr(abstract_state, "gradsync") else None)
+    if target_sig is not None:
+        md_gs, md_known = _gradsync_md()
+        if md_known and _sig(md_gs) != target_sig:
+            return _rekey(_restore_fresh_gradsync(md_gs))
+    try:
+        restored = mgr.restore(step, args=ocp.args.StandardRestore(target))
+    except Exception:
+        # failure-path shim (dialect-1 / mode-switch structure mismatches
+        # DO raise, and a fresh manager reaches here with its handler now
+        # registered by the failed attempt): same signature test, same
+        # stripped retry. A failure with MATCHING signatures is genuine
+        # corruption and propagates to the walk-back.
+        if target_sig is None:
+            raise
+        md_gs, md_known = _gradsync_md(_require=True)
+        if _sig(md_gs) == target_sig:
+            # the checkpoint's gradsync subtree matches the target's — the
+            # failure is NOT a dialect/mode/mesh mismatch (transient read,
+            # real corruption): re-raise rather than silently zeroing valid
+            # on-disk accumulators under a misleading dialect event
+            raise
+        return _rekey(_restore_fresh_gradsync(md_gs))
+    if target_sig is not None:
+        # post-restore audit for the fresh-manager path: the successful
+        # restore registered the handler, so the metadata is readable NOW
+        # — if the on-disk accumulators never matched the target's, the
+        # "success" above was orbax's silent slice and the sliced state
+        # must be discarded for the fresh-zero cold start
+        md_gs, md_known = _gradsync_md()
+        if md_known and _sig(md_gs) != target_sig:
+            from moco_tpu.utils.logging import log_event
+
+            log_event(
+                "ckpt-dialect",
+                f"step {step}'s gradsync accumulators do not match this "
+                "run's (different mesh size — the restore sliced instead "
+                "of failing); discarding them: error-feedback/momentum "
+                "state restarts from zeros",
+            )
+            restored = restored.replace(gradsync=abstract_state.gradsync)
     return _rekey(restored)
 
 

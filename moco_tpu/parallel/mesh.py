@@ -18,6 +18,7 @@ available later by re-sharding if ever needed.
 from __future__ import annotations
 
 import os
+import re
 from typing import Sequence
 
 import jax
@@ -40,9 +41,23 @@ def force_cpu_devices(n: int = 8) -> None:
     Note: the environment's sitecustomize force-registers a TPU ("axon")
     platform and overrides `JAX_PLATFORMS`, so setting the env var alone is
     not enough — we also set the config in-process.
+
+    An explicit `n` REPLACES any count already present in XLA_FLAGS: an
+    elastic resize relaunch (ISSUE 11) passes the NEW count via
+    `--fake-devices` while the child env still carries the old
+    incarnation's flags — respecting the stale value would silently pin
+    every relaunch to the original mesh and make the resize a no-op.
+    (Still before the first backend query, as ever: once the CPU client
+    exists the count is baked.)
     """
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            f"--xla_force_host_platform_device_count={n}", flags,
+        )
+        os.environ["XLA_FLAGS"] = flags
+    else:
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}"
         ).strip()

@@ -23,10 +23,16 @@ this package makes that survivable without a babysitter:
   kills it on heartbeat staleness, classifies every death via the
   `exitcodes` protocol + forensics, and restarts within a
   progress-refunded budget. CLI: tools/supervise.py.
+- `resize.ResizeListener`/`ResizeController` (ISSUE 11) — elastic
+  training: a resize.request trigger file or SIGUSR2 makes the driver
+  take a clean elastic checkpoint and exit `EXIT_RESIZE`; the supervisor
+  rewrites the relaunch argv (device count, grad-sync cadence, fresh
+  compile cache) and `--resume auto` + the checkpoint dialect shim land
+  the state on the new mesh.
 - `chaos.ChaosPlan` — the deterministic fault-injection harness that
   makes all of the above TESTABLE on CPU: SIGTERM-at-step-k,
   kill/freeze-at-step-k (process death / wedged-collective simulation),
-  NaN-at-step-k, loader faults, checkpoint truncation.
+  resize-at-step-k, NaN-at-step-k, loader faults, checkpoint truncation.
 
 Errors are typed (`errors.py`) so callers can route retryable faults
 (`TransientDataError`) differently from run-enders
@@ -54,6 +60,7 @@ from moco_tpu.resilience.exitcodes import (
     EXIT_DATA_QUALITY,
     EXIT_OK,
     EXIT_PREEMPTED,
+    EXIT_RESIZE,
     EXIT_ROLLBACK_EXHAUSTED,
 )
 from moco_tpu.resilience.integrity import (
@@ -62,6 +69,15 @@ from moco_tpu.resilience.integrity import (
     write_manifest,
 )
 from moco_tpu.resilience.preemption import PreemptionHandler
+from moco_tpu.resilience.resize import (
+    ResizeController,
+    ResizeListener,
+    ResizeRequest,
+    consume_resize_request,
+    parse_resize_request,
+    read_recorded_devices,
+    write_resize_request,
+)
 from moco_tpu.resilience.sentinel import NaNSentinel
 from moco_tpu.resilience.supervisor import (
     RestartPolicy,
@@ -80,10 +96,14 @@ __all__ = [
     "EXIT_DATA_QUALITY",
     "EXIT_OK",
     "EXIT_PREEMPTED",
+    "EXIT_RESIZE",
     "EXIT_ROLLBACK_EXHAUSTED",
     "NaNSentinel",
     "NonFiniteLossError",
     "PreemptionHandler",
+    "ResizeController",
+    "ResizeListener",
+    "ResizeRequest",
     "RestartPolicy",
     "RollbackExhaustedError",
     "StepWatchdog",
@@ -95,10 +115,14 @@ __all__ = [
     "preflight_resume",
     "chaos_context",
     "clear_chaos",
+    "consume_resize_request",
     "install_chaos",
     "manifest_path",
     "parse_chaos_spec",
+    "parse_resize_request",
+    "read_recorded_devices",
     "truncate_checkpoint",
     "verify_step",
     "write_manifest",
+    "write_resize_request",
 ]

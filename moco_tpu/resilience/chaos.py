@@ -89,6 +89,24 @@ class ChaosPlan:
                                             # dying mid-load; only the fleet
                                             # supervisor + router retry recover
                                             # it
+    resize_at_step: int | None = None       # elastic-resize drill (ISSUE
+                                            # 11): after step k, write a
+                                            # resize.request (devices=
+                                            # resize_devices) and exit
+                                            # EXIT_RESIZE through the same
+                                            # clean-checkpoint path an
+                                            # operator request takes — the
+                                            # supervisor relaunches onto the
+                                            # new mesh. Fire-once with
+                                            # MOCO_TPU_CHAOS_STATE, so the
+                                            # resized relaunch (which
+                                            # re-traverses nothing — the
+                                            # elastic ckpt is AT step k —
+                                            # but re-polls every later step)
+                                            # is never re-poisoned
+    resize_devices: int = 0                 # target device count for the
+                                            # drill (spec alias: `devices=M`;
+                                            # 0 = "whatever is visible")
     wedge_at_request: int | None = None     # serve-side: after the k-th
                                             # admitted request, STOP answering
                                             # (every later HTTP request —
@@ -194,6 +212,22 @@ class ChaosPlan:
             return True
         return False
 
+    def maybe_resize(self, step: int) -> int | None:
+        """The target device count at the configured step (fire-once,
+        marker-persisted like kill/freeze: the relaunched child must not
+        re-fire the drill into a resize loop); None otherwise. 0 means
+        "resize without pinning a count". The caller (the driver) writes
+        the resize.request and exits through the operator path — the drill
+        exercises the REAL loop, not a simulation of it."""
+        if self.resize_at_step == step and self._fire_once("resize"):
+            log_event(
+                "chaos",
+                f"injecting resize request at step {step} "
+                f"(devices={self.resize_devices or 'visible'})",
+            )
+            return self.resize_devices
+        return None
+
     def maybe_nan(self, step: int) -> bool:
         """True at the configured step (the first `nan_count` traversals of
         it): the caller replaces the step's reported loss with NaN — the
@@ -241,7 +275,13 @@ _INT_FIELDS = (
     "loader_error_count",
     "kill_at_request",
     "wedge_at_request",
+    "resize_at_step",
+    "resize_devices",
 )
+
+# spec-key sugar: the resize drill reads `resize_at_step=6,devices=2`
+# (the ISSUE 11 spelling) as well as the explicit field name
+_SPEC_ALIASES = {"devices": "resize_devices"}
 
 
 def parse_chaos_spec(spec: str) -> ChaosPlan | None:
@@ -254,7 +294,7 @@ def parse_chaos_spec(spec: str) -> ChaosPlan | None:
     kw: dict[str, int] = {}
     for part in spec.split(","):
         key, _, value = part.partition("=")
-        key = key.strip()
+        key = _SPEC_ALIASES.get(key.strip(), key.strip())
         if key not in _INT_FIELDS:
             raise ValueError(
                 f"unknown chaos fault {key!r}; known: {', '.join(_INT_FIELDS)}"

@@ -32,6 +32,12 @@ EXIT_FLEET_BIND = 48           # tools/serve_fleet.py could not bind the
                                # FRONT-END router port (the replica ports are
                                # the replicas' own 47s): same fatal semantics
                                # — rescheduling beats racing the socket
+EXIT_RESIZE = 49               # elastic resize honored (ISSUE 11): a clean
+                               # checkpoint was written and the driver exited
+                               # so the supervisor can relaunch it onto a
+                               # DIFFERENT mesh — like a preemption's 43
+                               # (restart immediately, no backoff) but the
+                               # relaunch argv changes (device count, cadence)
 
 # argparse's own usage-error exit — not ours to raise, but the classifier
 # treats it like EXIT_CONFIG_ERROR (same argv can never succeed)
@@ -45,5 +51,6 @@ EXIT_CODE_NAMES: dict[int, str] = {
     EXIT_DATA_QUALITY: "data_quality",
     EXIT_SERVE_BIND: "serve_bind",
     EXIT_FLEET_BIND: "fleet_bind",
+    EXIT_RESIZE: "resize",
     USAGE_ERROR: "usage_error",
 }

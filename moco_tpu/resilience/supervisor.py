@@ -52,9 +52,15 @@ from moco_tpu.resilience.exitcodes import (
     EXIT_FLEET_BIND,
     EXIT_OK,
     EXIT_PREEMPTED,
+    EXIT_RESIZE,
     EXIT_ROLLBACK_EXHAUSTED,
     EXIT_SERVE_BIND,
     USAGE_ERROR,
+)
+from moco_tpu.resilience.resize import (
+    ResizeController,
+    argv_device_count,
+    read_recorded_devices,
 )
 # pure-stdlib by contract (mocolint R12; the lazy telemetry __init__ keeps
 # this import numpy/jax-free): the supervisor is the trace ROOT — it mints
@@ -82,6 +88,9 @@ CLASS_CRASH = "crash"                          # any other nonzero exit
 CLASS_SERVE_BIND = "serve_bind"                # serve.py couldn't bind its port
 CLASS_FLEET_BIND = "fleet_bind"                # serve_fleet.py couldn't bind
                                                # its front-end router port
+CLASS_RESIZE = "resize"                        # elastic checkpoint written;
+                                               # relaunch onto the new mesh
+                                               # (ISSUE 11)
 
 # classes where restarting can never help — the run is OVER
 FATAL_CLASSES = frozenset({
@@ -90,7 +99,7 @@ FATAL_CLASSES = frozenset({
 })
 RESTARTABLE_CLASSES = frozenset({
     CLASS_PREEMPTED, CLASS_HANG, CLASS_NATIVE_CRASH, CLASS_OOM,
-    CLASS_KILLED, CLASS_CRASH,
+    CLASS_KILLED, CLASS_CRASH, CLASS_RESIZE,
 })
 
 _CRASH_SIGNALS = {
@@ -183,6 +192,7 @@ def classify_exit(
         # orchestrator one level up must reschedule, not retry-loop
         EXIT_SERVE_BIND: CLASS_SERVE_BIND,
         EXIT_FLEET_BIND: CLASS_FLEET_BIND,
+        EXIT_RESIZE: CLASS_RESIZE,
         USAGE_ERROR: CLASS_CONFIG_ERROR,
     }
     if returncode in named:
@@ -316,9 +326,12 @@ class RestartPolicy:
                                       # never; there is no portable way to
                                       # read the cgroup limit from here)
     restart_on: frozenset = RESTARTABLE_CLASSES
-    no_backoff: frozenset = frozenset({CLASS_PREEMPTED})
+    no_backoff: frozenset = frozenset({CLASS_PREEMPTED, CLASS_RESIZE})
                                       # a preempted VM that came back is
-                                      # healthy — relaunch immediately
+                                      # healthy — relaunch immediately; a
+                                      # resize exit is VOLUNTARY (the child
+                                      # checkpointed on request) — backoff
+                                      # would just stretch the capacity gap
 
     def backoff_secs(self, consecutive_failures: int, rng: random.Random) -> float:
         """Exponential in the number of consecutive no-progress failures,
@@ -365,6 +378,9 @@ class Supervisor:
         child_log_path: str = "",
         seed: int | None = None,
         time_fn=time.monotonic,
+        resize_device_flag: str = "",
+        resize_slow_cadence: int = 0,
+        resize_rotate_cache: bool = True,
     ):
         self.child_argv = list(child_argv)
         self.telemetry_dir = telemetry_dir
@@ -400,6 +416,25 @@ class Supervisor:
         self._ever_beat = False  # any beat in any launch: distinguishes a
                                  # wedged child from a missing heartbeat
                                  # channel (telemetry off / wrong dir)
+        # elastic resize (ISSUE 11): trigger-file / SIGUSR2 requests and
+        # the relaunch-argv rewrite. tools/supervise.py routes the
+        # supervisor's own SIGUSR2 to resize.signal_resize.
+        self.resize = ResizeController(
+            telemetry_dir, device_flag=resize_device_flag,
+            slow_cadence=resize_slow_cadence,
+            rotate_cache=resize_rotate_cache,
+        )
+        # per-launch extra env (the resize rewrite's fresh compile-cache
+        # dir lands here; _launch overlays it on the base env)
+        self._launch_env: dict = {}
+        self._last_mesh_change: tuple | None = None
+        self._resize_signaled = False
+        self._resize_request_emitted = False
+        # argv length snapshot taken just before a resize rewrite: if the
+        # VERY NEXT launch dies config_error (an unsatisfiable device
+        # count), the appended flags are reverted instead of ending the
+        # run — a typo'd resize request must not take a healthy run down
+        self._resize_fallback: int | None = None
 
     # -- structured incidents (same stream the child writes) ----------------
     def _emit(self, event: str, **fields) -> None:
@@ -480,6 +515,7 @@ class Supervisor:
         # span (the per-launch `child` span run() holds open) — one
         # trace_id from supervisor through driver to staging worker
         env = dict(os.environ if self.env is None else self.env)
+        env.update(self._launch_env)  # resize: fresh per-resize cache dir
         env.update(self.tracer.child_env())
         log_file = open(self.child_log_path, "ab")
         try:
@@ -527,8 +563,12 @@ class Supervisor:
         last_t = None         # the beat's own wall-clock stamp
         warned_pid = False
         hang_detection = self.policy.heartbeat_stale_secs > 0
+        self._resize_signaled = False  # a still-armed request re-signals
+                                       # THIS launch once it starts stepping
         while child.poll() is None:
             time.sleep(self.policy.poll_secs)
+            self._poll_resize(child, beat_phase, hang_detection,
+                              self._now() - launched)
             if not hang_detection:
                 continue  # non-main pod hosts: no heartbeat ever exists
             hb = read_heartbeat(self.heartbeat_path)
@@ -596,6 +636,54 @@ class Supervisor:
                 self._note_trace_state(hb)
         return False
 
+    def _poll_resize(self, child: subprocess.Popen,
+                     beat_phase: str | None,
+                     hang_detection: bool,
+                     child_age: float) -> None:
+        """Arm a pending resize request (trigger file / SIGUSR2-to-the-
+        supervisor) and signal the child to take its elastic checkpoint.
+
+        The signal is HELD until the newest beat says the child is in its
+        step loop: before that (jax import, compile, restore) the driver
+        has not installed its SIGUSR2 listener yet, and the default
+        disposition would TERMINATE the child mid-boot. With hang
+        detection off there is no phase to wait for — signal immediately,
+        best effort. `hang_detection` is the monitor's LIVE state, not
+        the policy knob: a run whose heartbeat channel turned out missing
+        (the `no_heartbeat` incident) will never produce a "step" beat,
+        and holding the signal there would strand an armed request — its
+        trigger file already consumed — forever. SIGUSR2 goes to the Popen pid; a
+        wrapper command (srun, docker) that doesn't forward it still
+        converges — the child's own listener polls the same trigger file,
+        and the file claim is atomic (exactly one side wins; both roads
+        end at an EXIT_RESIZE)."""
+        req = self.resize.poll()
+        if req is not None:
+            self._resize_signaled = False
+            self._resize_request_emitted = True
+            self.tracer.instant("resize_request", cat="supervisor",
+                                source=req.source, devices=req.devices)
+            self._emit(
+                "resize_request", pid=child.pid, source=req.source,
+                devices=req.devices, grad_sync_cadence=req.grad_sync_cadence,
+                slow=req.slow,
+            )
+        if self.resize.armed is None or self._resize_signaled:
+            return
+        # no-heartbeat children still get the startup grace before the
+        # signal: an immediate SIGUSR2 would land during jax import on
+        # EVERY relaunch (no handler yet → terminated mid-boot) and one
+        # armed request would kill-loop the run to budget exhaustion
+        ready_blind = (not hang_detection
+                       and child_age > self.policy.startup_grace_secs)
+        if beat_phase == "step" or ready_blind:
+            self._resize_signaled = True
+            try:
+                child.send_signal(signal.SIGUSR2)
+            except OSError:
+                pass  # child died between poll() and the signal: the exit
+                      # classification (and resize.take) handle the rest
+
     def _note_trace_state(self, hb: dict) -> None:
         """"Currently profiling" surfacing (ISSUE 8 satellite): the beat
         carries the child's capture state, so the operator watching
@@ -616,6 +704,75 @@ class Supervisor:
             capture_budget=trace_state.get("capture_budget"),
         )
 
+    # -- elastic resize (ISSUE 11) ------------------------------------------
+    def _apply_resize(self, child_span, step: int) -> None:
+        """Consume the honored resize request and rewrite the relaunch:
+        device-count append (argparse last-wins), optional grad-sync
+        cadence override for slow-linked meshes, fresh per-resize compile
+        cache dir. Emits the `resize_relaunch` incident and records the
+        whole request→relaunch interval as a `resize` span parented under
+        the exiting launch's `child` span (retroactive: the interval is
+        only known now — the Tracer's record_span API exists for exactly
+        this shape)."""
+        t_armed = self.resize.armed_at_wall or time.time()
+        req = self.resize.take()
+        if not self._resize_request_emitted:
+            # the child honored the request before the supervisor's poll
+            # ever armed it (the chaos drill, or the child's own file
+            # claim): the request must still appear in the stream — a
+            # report showing relaunches "from 0 requests" reads as
+            # resizes nobody asked for
+            self._emit(
+                "resize_request", source=req.source, devices=req.devices,
+                grad_sync_cadence=req.grad_sync_cadence, slow=req.slow,
+            )
+        self._resize_request_emitted = False
+        # the rewrite sees the EFFECTIVE child env (base + overlay): a
+        # MOCO_TPU_NO_CACHE in the base env must suppress the cache
+        # rotation, not be shadowed by the empty overlay
+        env = dict(os.environ if self.env is None else self.env)
+        env.update(self._launch_env)
+        self._resize_fallback = len(self.child_argv)
+        summary = self.resize.apply(req, self.child_argv, env)
+        if "cache_dir" in summary:
+            self._launch_env["MOCO_TPU_CACHE_DIR"] = summary["cache_dir"]
+        summary["step"] = step
+        self.tracer.record_span(
+            "resize", t_armed, max(time.time() - t_armed, 0.0),
+            cat="supervisor", parent=child_span.context(), **summary,
+        )
+        self._emit("resize_relaunch", **summary)
+
+    def _check_mesh_change(self) -> None:
+        """`mesh_change` incident when the device count this launch's argv
+        pins differs from the mesh the newest checkpoint records (the
+        position sidecar's `devices` stamp) — the relaunch-preflight
+        membership check. Silent when either side is unknown (no sidecar
+        yet / argv leaves the mesh to the hardware): never guessed."""
+        if not self.ckpt_dir:
+            return
+        recorded = read_recorded_devices(self.ckpt_dir)
+        declared = argv_device_count(self.child_argv)
+        if recorded is None or declared is None:
+            return
+        step, old = recorded
+        if old == declared:
+            self._last_mesh_change = None
+            return
+        key = (step, old, declared)
+        if key == self._last_mesh_change:
+            return  # this exact mismatch was already reported
+        self._last_mesh_change = key
+        self.tracer.instant("mesh_change", cat="supervisor",
+                            devices_from=old, devices_to=declared)
+        self._emit(
+            "mesh_change", ckpt_step=step, devices_from=old,
+            devices_to=declared,
+            note="relaunch mesh differs from the newest checkpoint's "
+                 "recorded mesh; the dialect shim restores with fresh-zero "
+                 "gradsync accumulators",
+        )
+
     # -- main loop -----------------------------------------------------------
     def run(self) -> SupervisorResult:
         try:
@@ -630,6 +787,10 @@ class Supervisor:
         while True:
             if self.ckpt_dir and attempt > 0:
                 preflight_resume(self.ckpt_dir, emit=self._emit)
+            # membership check (ISSUE 11 satellite): the mesh this launch
+            # will build differs from the one the newest checkpoint was
+            # saved under — say so HERE, not first inside the restore shim
+            self._check_mesh_change()
             # one span per child LIFETIME (launch → death): the child's own
             # root spans parent under it via the env stamped in _launch,
             # so the merged timeline nests each incarnation's work beneath
@@ -655,11 +816,29 @@ class Supervisor:
             self._emit("exit", pid=child.pid, returncode=rc,
                        classification=cls, detail=detail,
                        progressed=progressed, last_step=marker_now)
+            # one-shot resize fallback: this exit is the FIRST after a
+            # resize rewrite (the snapshot is cleared here regardless of
+            # class). A config_error death on that launch means the
+            # rewritten argv can never boot (typo'd device count > the
+            # hardware): revert the appended flags and keep the run alive
+            # on the old mesh instead of ending it for a bad request.
+            reverted = False
+            if self._resize_fallback is not None:
+                snapshot, self._resize_fallback = self._resize_fallback, None
+                if cls == CLASS_CONFIG_ERROR:
+                    dropped = self.child_argv[snapshot:]
+                    del self.child_argv[snapshot:]
+                    reverted = True
+                    self._emit(
+                        "resize_revert", dropped=dropped, returncode=rc,
+                        note="resized argv failed config validation — "
+                             "relaunching on the previous mesh",
+                    )
             if cls == CLASS_CLEAN:
                 self._emit("done", launches=attempt + 1, restarts=attempt)
                 return SupervisorResult(cls, rc, attempt + 1, attempt,
                                         False, classifications)
-            if cls not in self.policy.restart_on:
+            if not reverted and cls not in self.policy.restart_on:
                 self._emit("give_up", reason=f"fatal class {cls}",
                            returncode=rc, restarts=attempt)
                 return SupervisorResult(cls, rc, attempt + 1, attempt,
@@ -677,6 +856,12 @@ class Supervisor:
                 )
                 return SupervisorResult(cls, rc, attempt + 1, attempt,
                                         True, classifications)
+            if cls == CLASS_RESIZE:
+                # the child honored the resize: rewrite the relaunch argv
+                # (device count, cadence override, fresh compile cache)
+                # before the next launch — the whole incident lands as one
+                # `resize` span under this launch's child span
+                self._apply_resize(child_span, step=marker_now)
             if cls not in self.policy.no_backoff:
                 delay = self.policy.backoff_secs(
                     self._consecutive_failures, self._rng

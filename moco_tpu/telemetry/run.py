@@ -291,9 +291,17 @@ class RunTelemetry:
             # the restart-budget refund: last completed step + this pid,
             # phase distinguishing a preemption exit (relaunch expected)
             # from a natural end
+            phase = "run_end"
+            if summary.get("preempted"):
+                phase = "preempt_exit"
+            elif summary.get("resized"):
+                # a resize exit expects a relaunch onto a NEW mesh; any
+                # non-"step" phase already widens the supervisor's
+                # staleness window during the elastic checkpoint
+                phase = "resize_exit"
             self.heartbeat.beat(
                 summary.get("last_step", self._step_hist.count),
-                phase="preempt_exit" if summary.get("preempted") else "run_end",
+                phase=phase,
                 trace=self.tracer.capture_state(),
             )
         self.registry.close()

@@ -45,12 +45,14 @@ from moco_tpu.resilience import (
     NaNSentinel,
     NonFiniteLossError,
     PreemptionHandler,
+    ResizeListener,
     RollbackExhaustedError,
     StepWatchdog,
     active_chaos,
     clear_chaos,
     install_chaos,
     parse_chaos_spec,
+    write_resize_request,
 )
 from moco_tpu.train_state import create_train_state
 from moco_tpu.train_step import build_encoder, build_optimizer, build_train_step
@@ -539,8 +541,13 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
     plan = active_chaos()
     sentinel = NaNSentinel() if config.loss_sentinel else None
     preempted = False
+    resized = False
     _resilience = contextlib.ExitStack()
     preempt = _resilience.enter_context(PreemptionHandler())
+    # elastic resize (ISSUE 11): SIGUSR2 or a <telemetry_dir>/resize.request
+    # trigger file asks for a clean checkpoint + EXIT_RESIZE so the
+    # supervisor can relaunch onto a different mesh
+    resize = _resilience.enter_context(ResizeListener(config.telemetry_dir))
     watchdog = _resilience.enter_context(StepWatchdog(config.watchdog_secs))
     try:
         for epoch in range(start_epoch, config.epochs):
@@ -623,7 +630,12 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                     # raising or breaking alone leaves the rest hung in the
                     # next collective. Multi-host runs agree on them at a
                     # fixed step cadence; single-host acts immediately.
+                    # refresh the resize flag from the trigger file (time-
+                    # gated; SIGUSR2 needs no poll) before the pod sync so
+                    # every host folds the same observation
+                    resize.poll()
                     preempt_agreed = False
+                    resize_agreed = False
                     abort_fail, abort_total = d_fail, d_total
                     if n_procs > 1:
                         abort_fail = abort_total = 0
@@ -633,11 +645,13 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
 
                             agg = multihost_utils.process_allgather(
                                 np.asarray(
-                                    [int(preempt.triggered), d_fail, d_total],
+                                    [int(preempt.triggered), d_fail, d_total,
+                                     int(resize.triggered)],
                                     np.int64,
                                 )
                             )
                             preempt_agreed = bool(agg[:, 0].max())
+                            resize_agreed = bool(agg[:, 3].max())
                             abort_fail = int(agg[:, 1].sum())
                             abort_total = int(agg[:, 2].sum())
                             if telemetry is not None:
@@ -706,6 +720,18 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                             writer.flush()
                     if plan is not None:
                         plan.maybe_sigterm(global_step)
+                        # elastic-resize drill (ISSUE 11): record the target
+                        # device count where the supervisor will look for
+                        # it, then exit through the same path an operator
+                        # request takes
+                        chaos_devices = plan.maybe_resize(global_step)
+                        if chaos_devices is not None:
+                            if config.telemetry_dir:
+                                write_resize_request(
+                                    config.telemetry_dir,
+                                    devices=chaos_devices or None,
+                                )
+                            resize.trigger()
                         # process-level faults (ISSUE 4): SIGKILL-grade death
                         # and wedged-collective freeze — both invisible to
                         # the in-process handlers, recoverable only by the
@@ -719,6 +745,13 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                         # every host agrees on — a signaled host breaking by
                         # itself would leave the others in a hung collective
                         preempted = True
+                        done = True
+                        break
+                    if resize_agreed or (n_procs == 1 and resize.triggered):
+                        # same finish-the-step-then-exit shape as preemption,
+                        # but the exit code says "relaunch me onto a NEW
+                        # mesh" (EXIT_RESIZE) instead of "same argv"
+                        resized = True
                         done = True
                         break
                     if global_step >= total_steps:
@@ -736,7 +769,7 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                 # NaN state would be checkpointed — then restored by the very
                 # rollback trying to escape it)
                 sentinel.flush()
-            if preempted:
+            if preempted or resized:
                 break  # no epoch eval/save: the emergency checkpoint follows
             # epoch summary stays CUMULATIVE (honest average incl. the
             # compile stall); the per-step line above reports rolling
@@ -798,7 +831,7 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                 # next epoch's compute; the integrity manifest is deferred to
                 # the next save / finalize_checkpoints
                 save_checkpoint(mgr, state, global_step, wait=False,
-                                position=(epoch + 1, 0))
+                                position=(epoch + 1, 0), devices=n_chips)
         if sentinel is not None:
             # the final step's loss is still pending (one-step lag)
             sentinel.flush()
@@ -815,35 +848,39 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
             # heartbeat's phase (preempt_exit vs run_end) so the supervisor
             # knows a relaunch is expected without scraping logs.
             telemetry.close(scalar_drops=writer.dropped, last_step=global_step,
-                            preempted=preempted)
+                            preempted=preempted, resized=resized)
         writer.close()
         if mgr is not None:
             # commit any in-flight async epoch save (and its deferred
             # manifest) BEFORE a rollback's restore walks the directory —
             # otherwise "latest" may be a step Orbax is still writing
             finalize_checkpoints(mgr)
-    if preempted and mgr is not None:
+    if (preempted or resized) and mgr is not None:
         # step-tagged emergency checkpoint: the position sidecar (plus the
         # mid-epoch `resume_skip` path) makes the resumed run bit-identical
         # to the uninterrupted one. `epoch`/`i` survive the loop: the
-        # preempted break only fires inside an iteration
+        # preempted/resized break only fires inside an iteration
         emergency_pos = ((epoch + 1, 0) if i + 1 >= steps_per_epoch
                          else (epoch, i + 1))
         log_event(
-            "preempt",
-            f"writing emergency checkpoint at step {global_step}, then "
-            "exiting cleanly",
+            "resize" if resized else "preempt",
+            f"writing {'elastic' if resized else 'emergency'} checkpoint at "
+            f"step {global_step}, then exiting cleanly",
             step=global_step, pid=os.getpid(),
         )
-        save_checkpoint(mgr, state, global_step, position=emergency_pos)
+        save_checkpoint(mgr, state, global_step, position=emergency_pos,
+                        devices=n_chips)
     if preempted:
         # surfaced to callers (absent otherwise): main() turns it into
         # EXIT_PREEMPTED so the supervisor can tell a preemption's clean
         # exit (“relaunch me”) from a natural end without log forensics
         last_metrics = dict(last_metrics, preempted=True)
+    if resized:
+        # main() turns it into EXIT_RESIZE: "relaunch me onto the new mesh"
+        last_metrics = dict(last_metrics, resized=True)
     if mgr is not None:
         finalize_checkpoints(mgr)
-    if config.export_path and is_main and not preempted:
+    if config.export_path and is_main and not preempted and not resized:
         # close the pretrain→probe loop: v1/v2 write the query encoder in the
         # reference checkpoint dialect (torchvision names) for evals.lincls /
         # evals.knn / export_detectron2; v3 writes its backbone tree dialect
@@ -867,15 +904,18 @@ def main(argv=None):
     """CLI entry. Exits through the named codes in resilience/exitcodes.py
     (the supervisor's classification protocol — lint rule R5 forbids bare
     `sys.exit(<int>)` here): 0 clean, EXIT_PREEMPTED after an honored
-    SIGTERM + emergency checkpoint, EXIT_ROLLBACK_EXHAUSTED /
-    EXIT_DATA_QUALITY for the deliberate run-enders a restart cannot fix,
-    EXIT_CONFIG_ERROR for a bad preset/flag. Anything else propagates as a
-    traceback (python's exit 1 → classified as a generic crash)."""
+    SIGTERM + emergency checkpoint, EXIT_RESIZE after an honored elastic
+    resize (clean checkpoint, relaunch onto a new mesh expected),
+    EXIT_ROLLBACK_EXHAUSTED / EXIT_DATA_QUALITY for the deliberate
+    run-enders a restart cannot fix, EXIT_CONFIG_ERROR for a bad
+    preset/flag. Anything else propagates as a traceback (python's exit 1
+    → classified as a generic crash)."""
     from moco_tpu.config import add_config_flags, collect_overrides
     from moco_tpu.resilience.exitcodes import (
         EXIT_CONFIG_ERROR,
         EXIT_DATA_QUALITY,
         EXIT_PREEMPTED,
+        EXIT_RESIZE,
         EXIT_ROLLBACK_EXHAUSTED,
     )
 
@@ -918,7 +958,15 @@ def main(argv=None):
     from moco_tpu.utils.cache import enable_persistent_cache
 
     enable_persistent_cache()
-    mesh = create_mesh(args.num_devices)
+    try:
+        mesh = create_mesh(args.num_devices)
+    except ValueError as e:
+        # more devices requested than exist (e.g. a typo'd resize request's
+        # --num-devices append): the same argv can never succeed — the
+        # supervisor must classify this config_error and revert/stop, not
+        # relaunch a generic "crash" into a loop
+        log_event("exit", f"mesh config error: {e}", code=EXIT_CONFIG_ERROR)
+        sys.exit(EXIT_CONFIG_ERROR)
     info(f"config: {config}")
     info(f"mesh: {mesh}")
     try:
@@ -934,6 +982,11 @@ def main(argv=None):
         log_event("exit", "preemption honored: emergency checkpoint written, "
                           "exiting for relaunch", code=EXIT_PREEMPTED)
         sys.exit(EXIT_PREEMPTED)
+    if metrics.get("resized"):
+        log_event("exit", "resize honored: elastic checkpoint written, "
+                          "exiting for relaunch onto the new mesh",
+                  code=EXIT_RESIZE)
+        sys.exit(EXIT_RESIZE)
 
 
 if __name__ == "__main__":

@@ -184,6 +184,22 @@ def test_p2_flags_axis_missing_from_mesh(mesh8):
     assert findings and "'data'" in findings[0].message
 
 
+def test_p2_p7_clean_on_resized_mesh_programs(mesh8):
+    """ISSUE 11 satellite: the elastic relaunch's 2-device step programs
+    are part of the audited surface — their collectives bind to the
+    RESIZED mesh (P2) and the donation contract survives the rebuild
+    (P7). The quantized record carries the [2, ...] accumulator leaves
+    the dialect shim rebuilds fresh-zero at a mesh hop."""
+    records = build_surface(mesh=mesh8, families=("resize",),
+                            with_cost=False)
+    assert [r.name for r in records] == ["resize/fused@2dev",
+                                         "resize/quantized@2dev"]
+    for rec in records:
+        assert rec.meta["mesh_size"] == 2
+        assert _run(rec, "P2") == [], rec.name
+        assert _run(rec, "P7") == [], rec.name
+
+
 def test_p3_fires_on_double_reduced_gradient(mesh8):
     """The ISSUE's second named fixture: grads pmean'd inline BEFORE the
     gradsync reduce — the classic silently-rescaled-gradient regression."""
@@ -406,6 +422,10 @@ def test_repo_gate_full_surface_clean_within_budget(tmp_path):
     assert {"serve/bucket1", "serve/bucket8", "serve/bucket32",
             "serve/bucket128"} <= names
     assert {"probe/train", "probe/v3"} <= names
+    # ISSUE 11: the resized-mesh step programs (the elastic 1→2 relaunch's
+    # compiles) are part of the audited surface, so P2 pins their
+    # collectives to the 2-device mesh
+    assert {"resize/fused@2dev", "resize/quantized@2dev"} <= names
 
     inv = json.load(open(inv_path))
     assert inv["program_count"] == len(names)

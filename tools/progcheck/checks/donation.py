@@ -27,7 +27,7 @@ class DonationAliases(Check):
     rationale = ("a donated buffer with no matching output silently "
                  "becomes a copy — the state's HBM footprint doubles and "
                  "the only witness is a lower-time warning nobody reads")
-    families = ("train", "v3", "aug_step")
+    families = ("train", "v3", "aug_step", "resize")
 
     def check_program(self, record):
         if not record.donated:

@@ -12,6 +12,12 @@ backend:
                      build_grad_probe / v3_step.build_v3_grad_probe)
   gradsync/<mode>  — the isolated region reduce (GradSync.
                      audit_region_program), the wire-bytes check's input
+  resize/<mode>@2dev — the train step REBUILT on a 2-device sub-mesh
+                     (ISSUE 11): the program an elastic 1→2 resize
+                     relaunch compiles. P2 pins its collectives to the
+                     resized mesh, P7 its donation contract — a step
+                     builder that bakes in the boot mesh size would trace
+                     fine at 8 devices and desync after every resize
   serve/bucket<N>  — the EmbeddingEngine program at each ladder bucket
   aug_step/<HxW>   — the fused aug+step program at each h2d_trim canvas
                      shape (trim rounds to 64, so the variant set is the
@@ -28,7 +34,9 @@ from __future__ import annotations
 
 import warnings
 
-FAMILIES = ("train", "v3", "probe", "gradsync", "serve", "aug_step", "eval")
+FAMILIES = ("train", "v3", "probe", "gradsync", "serve", "aug_step", "eval",
+            "resize")
+RESIZE_MESH_SIZE = 2  # the resized-mesh proxy (the 1→2→1 drill's middle leg)
 
 # the tiny proxy (mirrors tests/test_gradsync.py)
 B, IMG, DIM, K = 16, 16, 16, 64
@@ -322,6 +330,45 @@ def _aug_step_records(mesh, with_cost):
     return records
 
 
+def _resize_records(mesh, with_cost):
+    """The elastic-relaunch programs (ISSUE 11 satellite): the train step
+    rebuilt over a RESIZED sub-mesh. `fused` is the exact-DP baseline;
+    `quantized` additionally carries the [n_dev, ...] gradsync
+    accumulators the dialect shim rebuilds fresh-zero on a mesh-size
+    change — its collectives and donation over the 2-device mesh are what
+    the resized relaunch actually compiles."""
+    import jax
+    import jax.numpy as jnp
+
+    from moco_tpu.parallel.mesh import create_mesh
+    from moco_tpu.train_step import build_train_step
+    from tools.progcheck.inventory import make_record
+
+    if len(jax.devices()) < RESIZE_MESH_SIZE:
+        return []  # single-device backend: nothing to resize onto
+    small = create_mesh(RESIZE_MESH_SIZE)
+    im = jax.ShapeDtypeStruct((B, IMG, IMG, 3), jnp.float32)
+    records = []
+    for mode in ("fused", "quantized"):
+        config = _proxy_config(grad_sync=mode, **GRAD_SYNC_KNOBS)
+        state, model, tx, sched = _state_shapes(config, small)
+        step = build_train_step(config, model, tx, small, 8, sched)
+        closed = jax.make_jaxpr(step)(state, im, im)
+        flops, nbytes = _cost(step, (state, im, im), with_cost)
+        rec = make_record(
+            f"resize/{mode}@{RESIZE_MESH_SIZE}dev", "resize", mode, closed,
+            donated=_donated(closed),
+            meta={
+                "mesh_axes": tuple(str(a) for a in small.axis_names),
+                "mesh_size": small.size,
+            },
+        )
+        rec.flops = flops * small.size if flops is not None else None
+        rec.bytes_accessed = nbytes
+        records.append(rec)
+    return records
+
+
 def _eval_records(mesh, with_cost):
     import jax
     import jax.numpy as jnp
@@ -397,4 +444,6 @@ def build_surface(mesh=None, families=None, with_cost: bool = True):
         records.extend(_aug_step_records(mesh, with_cost))
     if "eval" in wanted:
         records.extend(_eval_records(mesh, with_cost))
+    if "resize" in wanted:
+        records.extend(_resize_records(mesh, with_cost))
     return records

@@ -73,6 +73,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-force-resume", action="store_true",
                    help="do NOT append `--resume auto` to the child on "
                         "restarts")
+    p.add_argument("--resize-device-flag", default="",
+                   help="flag used to pin the device count on a resize "
+                        "relaunch (ISSUE 11). Default: whichever of "
+                        "--num-devices/--fake-devices the child argv "
+                        "already uses, else --num-devices")
+    p.add_argument("--resize-slow-cadence", type=int, default=0,
+                   help="grad_sync_cadence override appended when a resize "
+                        "request flags the new mesh slow-linked (`slow=1` "
+                        "in resize.request); 0 = never override")
     p.add_argument("--shared-compile-cache", action="store_true",
                    help="let the child use the SHARED persistent XLA "
                         "compile cache. Default is a per-run "
@@ -97,9 +106,10 @@ def main(argv=None) -> int:
     if not child:
         build_parser().error("no child command given (append `-- python -m "
                              "moco_tpu.train ...`)")
-    if (not args.shared_compile_cache
-            and not os.environ.get("MOCO_TPU_CACHE_DIR")
-            and not os.environ.get("MOCO_TPU_NO_CACHE")):
+    owns_cache_dir = (not args.shared_compile_cache
+                      and not os.environ.get("MOCO_TPU_CACHE_DIR")
+                      and not os.environ.get("MOCO_TPU_NO_CACHE"))
+    if owns_cache_dir:
         # supervised runs are kill-risk BY DESIGN (hang-kill escalation,
         # chaos drills): isolate their compile cache so a SIGKILL mid-write
         # can't poison the shared one for every later process on this host.
@@ -128,7 +138,20 @@ def main(argv=None) -> int:
         policy=policy,
         force_resume=not args.no_force_resume,
         child_log_path=args.child_log,
+        resize_device_flag=args.resize_device_flag,
+        resize_slow_cadence=args.resize_slow_cadence,
+        # rotate the compile cache per resize only when the supervisor
+        # derived the cache dir itself: --shared-compile-cache and an
+        # operator-pinned MOCO_TPU_CACHE_DIR are explicit choices a
+        # resize must not silently override
+        resize_rotate_cache=owns_cache_dir,
     )
+    # SIGUSR2 to the SUPERVISOR requests an elastic resize (ISSUE 11): the
+    # next monitor cycle claims any pending resize.request payload (or an
+    # empty "resize to what's visible" request) and signals the child
+    import signal
+
+    signal.signal(signal.SIGUSR2, lambda *_: sup.resize.signal_resize())
     result = sup.run()
     info(
         f"supervisor: {result.final_class} after {result.launches} launch(es)"

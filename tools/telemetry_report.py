@@ -22,6 +22,10 @@ Renders, from the schema-versioned record stream the driver writes
   - supervisor lifecycle (ISSUE 4): launches/restarts/kills, death
     classifications, final budget state and outcome — the `kind:
     "supervisor"` records tools/supervise.py appends to the same stream
+  - elastic resize (ISSUE 11): requests, relaunches (old→new device
+    count, cadence overrides), and preflight mesh_change incidents from
+    the same supervisor stream, folded as a `resize:` section (and
+    rendered live by --follow, like fleet lines)
   - serving (ISSUE 5): request/shed counts, latency p50/p95/p99, batch
     count and mean bucket occupancy, embedding-cache hit rate — from the
     cumulative `kind: "serve"` snapshots the embedding service emits
@@ -267,6 +271,12 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
         if budgets:
             sup["budget_left"] = budgets[-1]
         summary["supervisor"] = sup
+        # elastic resize (ISSUE 11): the resize_* / mesh_change records ride
+        # the same supervisor stream; fold them into their own section so a
+        # resize reads as ONE incident (request → exit 49 → relaunch)
+        resize_sec = _summarize_resize(supervisor)
+        if resize_sec:
+            summary["resize"] = resize_sec
     if serves and not fleet:
         # snapshots are cumulative; the last one summarizes the run.
         # With FLEET records present this section is suppressed: N
@@ -289,6 +299,33 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
     if run_ends:
         summary["run_end"] = run_ends[-1]
     return summary
+
+
+def _summarize_resize(supervisor: list[dict]) -> dict | None:
+    """Fold resize_request / resize_relaunch / mesh_change supervisor
+    records into one `resize` section. None when the run saw none."""
+    requests = [r for r in supervisor if r.get("event") == "resize_request"]
+    relaunches = [r for r in supervisor
+                  if r.get("event") == "resize_relaunch"]
+    mesh_changes = [r for r in supervisor if r.get("event") == "mesh_change"]
+    reverts = [r for r in supervisor if r.get("event") == "resize_revert"]
+    if not (requests or relaunches or mesh_changes or reverts):
+        return None
+    sec: dict = {
+        "requests": len(requests),
+        "relaunches": len(relaunches),
+        "mesh_changes": len(mesh_changes),
+    }
+    if reverts:
+        sec["reverts"] = len(reverts)
+    transitions = []
+    for r in relaunches:
+        t = {k: r[k] for k in ("devices_from", "devices_to", "step",
+                               "grad_sync_cadence", "source") if k in r}
+        transitions.append(t)
+    if transitions:
+        sec["transitions"] = transitions
+    return sec
 
 
 def _summarize_fleet(fleet: list[dict], serves: list[dict]) -> dict:
@@ -540,6 +577,30 @@ def render(summary: dict) -> str:
             lines.append(f"  death classifications: {detail}")
         if "budget_left" in sup:
             lines.append(f"  restart budget left: {sup['budget_left']}")
+    rsz = summary.get("resize")
+    if rsz:
+        hops = []
+        for t in rsz.get("transitions", ()):
+            frm = t.get("devices_from")
+            arrow = (f"{'?' if frm is None else frm}→"
+                     f"{t.get('devices_to') or 'visible'}")
+            if "step" in t:
+                arrow += f"@{t['step']}"
+            if "grad_sync_cadence" in t:
+                arrow += f" (cadence {t['grad_sync_cadence']})"
+            hops.append(arrow)
+        lines.append(
+            f"resize: {rsz['relaunches']} relaunch(es) from "
+            f"{rsz['requests']} request(s)"
+            + (f" — {' · '.join(hops)}" if hops else "")
+            + (f" · {rsz['reverts']} reverted (unbootable argv)"
+               if rsz.get("reverts") else "")
+        )
+        if rsz.get("mesh_changes"):
+            lines.append(
+                f"  mesh changes observed at relaunch preflight: "
+                f"{rsz['mesh_changes']}"
+            )
     srv = summary.get("serve")
     if srv:
         shed = srv.get("shed_overload", 0) + srv.get("shed_deadline", 0)
@@ -684,7 +745,13 @@ def render_record(rec: dict) -> str | None:
             f"{k}={v}" for k, v in rec.items()
             if k not in ("v", "t", "kind", "event", "run_id", "trace_id")
         )
-        return f"supervisor: {rec.get('event', '?')} {detail}".rstrip()
+        event = str(rec.get("event", "?"))
+        if event.startswith("resize") or event == "mesh_change":
+            # elastic transitions get their own live-tail prefix (ISSUE 11
+            # satellite), same as fleet lines — a resize in progress should
+            # jump out of the step stream
+            return f"resize: {event} {detail}".rstrip()
+        return f"supervisor: {event} {detail}".rstrip()
     if kind == "fleet":
         detail = " ".join(
             f"{k}={v}" for k, v in rec.items()
