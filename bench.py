@@ -182,10 +182,11 @@ class _Orchestrator:
         print(json.dumps(self.record()), flush=True)
 
 
-def orchestrate(mode: str) -> None:
+def orchestrate(mode: str) -> dict:
     """Cheap-first, budget-bounded measurement. Never raises, never exits
     non-zero, always leaves at least one metric-bearing JSON line on stdout
-    (consumers take the LAST one)."""
+    (consumers take the LAST one). Returns the final consolidated record
+    (the `--gate` caller feeds it to tools/bench_gate.py)."""
     try:
         budget = float(os.environ.get("MOCO_TPU_BENCH_BUDGET_S",
                                       BENCH_TOTAL_BUDGET_S))
@@ -210,6 +211,7 @@ def orchestrate(mode: str) -> None:
         # their process on the next Ctrl-C
         for sig, prev in prev_handlers.items():
             signal.signal(sig, prev)
+    return orch.record()
 
 
 def _orchestrate_body(mode: str, orch: "_Orchestrator") -> None:
@@ -878,9 +880,31 @@ if __name__ == "__main__":
         help="run the measurement in THIS process (no retry shell); the "
              "default entry orchestrates children with retry + degradation",
     )
+    parser.add_argument(
+        "--gate", action="store_true",
+        help="after measuring, compare the final record against the "
+             "committed BENCH_r*.json trajectory (tools/bench_gate.py) "
+             "and exit 1 on regression — the opt-out-of-silent-drift "
+             "mode for CI (the default entry stays never-nonzero)",
+    )
     args = parser.parse_args()
     if not args.child:
-        orchestrate(args.mode)
+        record = orchestrate(args.mode)
+        if args.gate:
+            from tools.bench_gate import (
+                flatten,
+                gate_record,
+                load_trajectory_flats,
+            )
+
+            fresh, _ = flatten(record)
+            verdict = gate_record(fresh, load_trajectory_flats())
+            print(json.dumps({"bench_gate": {
+                "regressions": verdict["regressions"],
+                "compared": verdict["compared"],
+                "new_metrics": verdict["new_metrics"],
+            }}), flush=True)
+            sys.exit(1 if verdict["regressions"] or not fresh else 0)
     else:
         if os.environ.get("MOCO_TPU_FORCE_CPU"):
             # in-process platform switch — the sitecustomize overrides

@@ -164,6 +164,49 @@ def read_heartbeat(path: str) -> dict | None:
     return payload if isinstance(payload, dict) else None
 
 
+def beat_marker(hb: dict):
+    """Change-detection key for one heartbeat payload (ISSUE 12
+    satellite): the writer-side monotonic `seq` when present — a wall
+    step can make two distinct beats stamp the same `t` (backwards jump)
+    and silently mask progress — else the wall stamp for old payloads.
+    Tagged so a `seq` value can never compare equal to a `t` value."""
+    seq = hb.get("seq")
+    if isinstance(seq, int) and not isinstance(seq, bool):
+        return ("seq", seq)
+    return ("t", hb.get("t"))
+
+
+# how far the writer's (wall − mono) clock offset may differ from the
+# reader's before the two monotonic clocks are judged incomparable
+# (different host, or a wall step since the beat was written)
+_SAME_BOOT_SKEW_S = 5.0
+
+
+def beat_is_fresh(hb: dict, launched_wall: float,
+                  launched_mono: float) -> bool:
+    """Was this beat written after OUR launch? Prefers the monotonic
+    `mono_s` (CLOCK_MONOTONIC — shared by every process on a host, so it
+    orders a same-host child's write against the supervisor's launch
+    without consulting the steppable wall clock): a backward wall jump
+    can no longer unfresh a live child's beats. The mono comparison is
+    used only when the beat's own (t − mono_s) offset agrees with this
+    process's current offset — same boot, no wall step since the write —
+    because CLOCK_MONOTONIC is meaningless across hosts: a wrapper
+    child (srun) beating from ANOTHER node over a shared filesystem
+    keeps the wall-clock semantics that worked for it before the pair
+    existed. Old payloads without the pair fall back to wall `t`."""
+    mono = hb.get("mono_s")
+    t = hb.get("t")
+    wall_ok = isinstance(t, (int, float)) and not isinstance(t, bool)
+    if (isinstance(mono, (int, float)) and not isinstance(mono, bool)
+            and wall_ok):
+        offset_writer = t - mono
+        offset_reader = time.time() - time.monotonic()
+        if abs(offset_writer - offset_reader) <= _SAME_BOOT_SKEW_S:
+            return mono > launched_mono
+    return wall_ok and t > launched_wall
+
+
 def classify_exit(
     returncode: int,
     *,
@@ -558,9 +601,11 @@ class Supervisor:
         instead of kill-looping a healthy child forever."""
         launched = self._now()
         launched_wall = time.time()
+        launched_mono = time.monotonic()  # freshness basis for mono_s
+                                          # beats (wall-jump-immune)
         beat_phase = None     # phase of the newest beat from this child
         last_beat = launched  # supervisor-clock time of the newest beat
-        last_t = None         # the beat's own wall-clock stamp
+        last_marker = None    # the beat's own change marker (seq, else t)
         warned_pid = False
         hang_detection = self.policy.heartbeat_stale_secs > 0
         self._resize_signaled = False  # a still-armed request re-signals
@@ -578,12 +623,14 @@ class Supervisor:
                 # grandchild behind a wrapper (srun, bash -c, docker run),
                 # whose pid never equals Popen's. The freshness bound
                 # keeps a STALE file from the previous incarnation from
-                # arming the tight window during this child's compile.
+                # arming the tight window during this child's compile —
+                # judged on the heartbeat's monotonic mono_s when present
+                # (seq/mono_s pair: a wall-clock step must read as
+                # neither hang nor freshness), wall t for old payloads.
                 mine = hb.get("pid") == child.pid
-                fresh = isinstance(hb.get("t"), (int, float)) and \
-                    hb["t"] > launched_wall
-                if (mine or fresh) and hb.get("t") != last_t:
-                    last_t = hb.get("t")
+                fresh = beat_is_fresh(hb, launched_wall, launched_mono)
+                if (mine or fresh) and beat_marker(hb) != last_marker:
+                    last_marker = beat_marker(hb)
                     last_beat = self._now()
                     beat_phase = hb.get("phase")
                     self._ever_beat = True
@@ -606,7 +653,7 @@ class Supervisor:
                       else self.policy.startup_grace_secs)
             stale_for = self._now() - last_beat
             if stale_for > window:
-                if last_t is None and not self._ever_beat:
+                if last_marker is None and not self._ever_beat:
                     # no beat EVER, in this or any previous launch: the
                     # heartbeat channel itself is missing (telemetry off,
                     # mismatched --telemetry-dir) — killing a child that
@@ -631,8 +678,7 @@ class Supervisor:
             hb = read_heartbeat(self.heartbeat_path)
             if hb is not None and (
                     hb.get("pid") == child.pid
-                    or (isinstance(hb.get("t"), (int, float))
-                        and hb["t"] > launched_wall)):
+                    or beat_is_fresh(hb, launched_wall, launched_mono)):
                 self._note_trace_state(hb)
         return False
 

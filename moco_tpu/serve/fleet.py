@@ -69,6 +69,7 @@ from moco_tpu.resilience.supervisor import (
     QUARANTINE_DIRNAME,
     classify_exit,
 )
+from moco_tpu.telemetry.aggregate import PercentileWindow
 from moco_tpu.telemetry.trace import Tracer
 from moco_tpu.utils.logging import log_event
 
@@ -82,6 +83,11 @@ _EXPORT_SUFFIXES = (".safetensors", ".npz", ".bin")
 SHED_NO_BACKEND = "no_healthy_backend"
 SHED_UPSTREAM_TIMEOUT = "upstream_timeout"
 SHED_UPSTREAM_ERROR = "upstream_error"
+SHED_DEADLINE_ROUTER = "deadline_exceeded"  # budget elapsed AT the router
+                                            # (same code the replica uses —
+                                            # a client retries either the
+                                            # same way — but counted in its
+                                            # own router_stats bucket)
 
 
 class FleetLaunchError(RuntimeError):
@@ -126,7 +132,11 @@ class FleetPolicy:
     reload_timeout_s: float = 300.0    # one replica's /admin/reload budget
                                        # (checkpoint load + full ladder
                                        # warmup, off the request path)
-    stats_every_secs: float = 30.0     # router_stats event cadence
+    stats_every_secs: float = 30.0     # router_stats event cadence (the
+                                       # autoscaler input stream; see
+                                       # _emit_router_stats for the schema)
+    stats_latency_window: int = 512    # router-latency ring size behind
+                                       # the router_stats p50/p95/p99
 
     def backoff_secs(self, consecutive_failures: int,
                      rng: random.Random) -> float:
@@ -484,8 +494,14 @@ class FleetSupervisor:
         self.r_shed_no_backend = 0
         self.r_upstream_timeout = 0
         self.r_upstream_error = 0
+        self.r_deadline_router = 0     # budget elapsed AT the router before
+                                       # an attempt could even be forwarded
         self.r_passthrough_error = 0   # replica answered non-200 (its own
                                        # structured shed: counted, passed)
+        # answered-request latency window (lock-free GIL-atomic appends
+        # from handler threads) behind router_stats' p50/p95/p99
+        self._router_latency = PercentileWindow(
+            self.policy.stats_latency_window)
 
     # -- structured events ---------------------------------------------------
     def _emit(self, event: str, **fields) -> None:
@@ -623,6 +639,7 @@ class FleetSupervisor:
             "shed_no_backend": self.r_shed_no_backend,
             "upstream_timeout": self.r_upstream_timeout,
             "upstream_error": self.r_upstream_error,
+            "shed_deadline_router": self.r_deadline_router,
             "passthrough_non_200": self.r_passthrough_error,
         }
 
@@ -659,18 +676,36 @@ class FleetSupervisor:
         DIFFERENT replica → answer. Returns (status, response bytes)."""
         with self._lock:
             self.r_requests += 1
-        deadline = time.monotonic() + self._deadline_s(body)
+        t_start = time.monotonic()
+        deadline = t_start + self._deadline_s(body)
         tried: list[int] = []
         last_err = "?"
         for attempt in (0, 1):
             replica = self.pick_backend(exclude=tried)
             if replica is None:
+                if tried:
+                    # the client DID wait through a failed attempt before
+                    # this shed — that time belongs in the window (a
+                    # zero-wait first-attempt shed does not: thousands of
+                    # instant 503s during an outage would bury the tail)
+                    self._router_latency.observe(
+                        time.monotonic() - t_start)
                 return self._shed_no_backend()
             tried.append(replica.index)
             remaining = deadline - time.monotonic()
             if remaining <= 0.01:
+                # the picked replica never saw the request: hand its
+                # outstanding slot back (leaking it here would skew
+                # least-outstanding AND the autoscaler's depth gauge
+                # upward forever), and the elapsed time — the client DID
+                # wait the whole deadline — belongs in the latency
+                # window like the upstream-timeout case
+                self.release_backend(replica)
+                self._router_latency.observe(time.monotonic() - t_start)
+                with self._lock:
+                    self.r_deadline_router += 1
                 return 504, json.dumps({
-                    "error": "deadline_exceeded",
+                    "error": SHED_DEADLINE_ROUTER,
                     "detail": "request deadline elapsed at the router",
                 }).encode()
             try:
@@ -687,7 +722,10 @@ class FleetSupervisor:
             except (TimeoutError, OSError) as e:
                 # a timeout consumed the request's own deadline: answer
                 # structured, eject (the probe readmits a merely-slow
-                # replica on its next success), do NOT replay
+                # replica on its next success), do NOT replay. The elapsed
+                # time IS the client-observed latency — it belongs in the
+                # window (the autoscaler's p99 must see the timeouts)
+                self._router_latency.observe(time.monotonic() - t_start)
                 self.eject(replica, f"timeout:{type(e).__name__}")
                 with self._lock:
                     self.r_upstream_timeout += 1
@@ -698,6 +736,7 @@ class FleetSupervisor:
                 }).encode()
             finally:
                 self.release_backend(replica)
+            self._router_latency.observe(time.monotonic() - t_start)
             with self._lock:
                 if status == 200:
                     self.r_ok += 1
@@ -706,6 +745,9 @@ class FleetSupervisor:
                 else:
                     self.r_passthrough_error += 1
             return status, data
+        # both attempts failed: the client-observed wait is real and the
+        # autoscaler's p99 must see it, like the timeout/deadline paths
+        self._router_latency.observe(time.monotonic() - t_start)
         with self._lock:
             self.r_upstream_error += 1
         return 502, json.dumps({
@@ -999,9 +1041,13 @@ class FleetSupervisor:
             self._emit("roll_begin", replicas=roll["queue"])
         if roll["idx"] is None:
             if not roll["queue"]:
+                # record FIRST, then publish completion: rolling_restart
+                # polls `_roll is None`, and clearing first lets a caller
+                # observe "roll done" before the roll_end record exists
+                # (its next read of the incident log misses the event)
+                self._emit("roll_end")
                 with self._lock:
                     self._roll = None
-                self._emit("roll_end")
                 return
             idx = roll["queue"][0]
             r = self.replicas[idx]
@@ -1206,11 +1252,40 @@ class FleetSupervisor:
             self._stop.wait(poll)
 
     def _emit_router_stats(self, final: bool = False) -> None:
+        """The autoscaler input record (ISSUE 12 satellite): one
+        `kind:"fleet", event:"router_stats"` line on a fixed time
+        cadence (`stats_every_secs`, plus one `final` at stop). STABLE
+        SCHEMA — obsd and ROADMAP 2b's autoscaler key on it:
+
+          requests/ok/retries/retry_ok        cumulative counters
+          shed_no_backend / upstream_timeout /
+          upstream_error / shed_deadline_router /
+          passthrough_non_200                 cumulative per-code sheds
+          outstanding                         in-flight depth gauge NOW
+          healthy / replicas                  rotation-eligible / total
+          latency_ms {p50,p95,p99} + window   answered-request latency
+                                              over the trailing ring
+                                              (absent until any answer)
+          interval_s                          the emit cadence, so a
+                                              consumer can rate-convert
+                                              counter deltas
+
+        Consumers take DELTAS between consecutive records for rates (the
+        counters are cumulative — a last-snapshot fold stays valid)."""
         with self._lock:
             counters = self._router_counters()
             healthy = sum(
                 1 for r in self.replicas
                 if r.healthy and not r.draining and not r.abandoned
             )
+            outstanding = sum(r.outstanding for r in self.replicas)
+        extras: dict = {
+            "outstanding": outstanding,
+            "replicas": len(self.replicas),
+            "interval_s": self.policy.stats_every_secs,
+        }
+        if self._router_latency.count:
+            extras["latency_ms"] = self._router_latency.percentiles_ms()
+            extras["window"] = self._router_latency.count
         self._emit("router_stats", final=final, healthy=healthy,
-                   **counters)
+                   **counters, **extras)

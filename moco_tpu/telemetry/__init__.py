@@ -31,6 +31,13 @@ import importlib
 
 # public name -> submodule that defines it
 _EXPORTS = {
+    "Aggregator": "aggregate",
+    "ObsServer": "aggregate",
+    "PercentileWindow": "aggregate",
+    "SLOEngine": "aggregate",
+    "SLORule": "aggregate",
+    "StreamTailer": "aggregate",
+    "load_rules": "aggregate",
     "DeviceMonitor": "device",
     "host_rss_bytes": "device",
     "MFUEstimator": "mfu",

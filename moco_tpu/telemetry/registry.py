@@ -280,16 +280,31 @@ class Heartbeat:
 
     `min_interval_secs` gates `maybe_beat` (the every-step call): one
     atomic replace per second is free, one per 100 ms step is not. `beat`
-    always writes (lifecycle transitions must never be elided)."""
+    always writes (lifecycle transitions must never be elided).
+
+    Besides the wall-clock `t`, every beat carries a monotonic pair
+    (ISSUE 12 satellite): `seq` (a per-process counter — did ANYTHING
+    change since the reader's last look?) and `mono_s`
+    (`time.monotonic()`, CLOCK_MONOTONIC — system-wide since boot on
+    Linux, so a same-host reader can order beats against its own
+    monotonic clock). Staleness/freshness readers (the run supervisor)
+    prefer the pair when present: an NTP step or a manual clock change
+    moves `t` but neither `seq` nor `mono_s`, so a wall jump can no
+    longer read as "hung child" (backwards) or make a stale file look
+    fresh (forwards)."""
 
     def __init__(self, path: str, min_interval_secs: float = 0.0):
         self.path = path
         self.min_interval = float(min_interval_secs)
         self._last_write = float("-inf")
+        self._seq = 0
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
     def beat(self, step: int, **fields) -> None:
+        self._seq += 1
         payload = {"v": SCHEMA_VERSION, "t": round(time.time(), 3),
+                   "seq": self._seq,
+                   "mono_s": round(time.monotonic(), 3),
                    "step": int(step), "pid": os.getpid()}
         payload.update(fields)
         tmp = self.path + ".tmp"

@@ -195,6 +195,12 @@ class RunTelemetry:
         record = dict(step=int(step))
         for key, value in phases.items():
             record[key] = round(value, 6)
+        if phases.get("step_s"):
+            # the data-stall share, stamped per record (ISSUE 12): the
+            # SLO rules and the live tail key on it directly instead of
+            # each consumer re-deriving data_s/step_s
+            record["data_share"] = round(
+                phases.get("data_s", 0.0) / phases["step_s"], 4)
         rolling = throughput.rolling_imgs_per_sec
         record["imgs_per_sec"] = round(rolling, 2)
         record["imgs_per_sec_cum"] = round(throughput.imgs_per_sec, 2)

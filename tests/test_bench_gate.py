@@ -1,0 +1,198 @@
+"""bench_gate (ISSUE 12 satellite): perf regressions fail loudly.
+
+  - flatten: metric-bearing lines from wrappers, stdout text, nested
+    input/e2e folds, detail rows deliberately not gated
+  - gate_record: tolerance semantics both directions, newest-baseline
+    selection, metric-name isolation (a degraded CPU-proxy round never
+    compares against an 8-chip one)
+  - infra-failed rounds (parsed null / rc!=0) contribute no baselines
+  - CLI exit codes: 0 pass / 1 regression / 2 usage
+  - THE tier-1 pin: --self-test replays the committed BENCH_r01→r05
+    trajectory with the DEFAULT tolerances and finds zero false
+    regressions — the guard that keeps the defaults honest
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+from tools.bench_gate import (
+    flatten,
+    gate_record,
+    load_trajectory,
+    self_test,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "tools", "bench_gate.py")
+
+
+def _wrapper(parsed=None, tail_records=(), rc=0):
+    tail = "".join(json.dumps(r) + "\n" for r in tail_records)
+    return {"n": 1, "cmd": "python bench.py", "rc": rc, "tail": tail,
+            "parsed": parsed}
+
+
+# ---------------------------------------------------------------------------
+# flatten
+# ---------------------------------------------------------------------------
+
+
+def test_flatten_wrapper_with_nested_folds():
+    rec = {"metric": "m_step", "value": 100.0, "unit": "imgs/sec/chip",
+           "vs_baseline": 1.0, "final_loss": 4.2,
+           "input": {"value": 500.0, "unit": "imgs/sec",
+                     "detail": {"a": 1.0, "b": 2.0}},
+           "e2e": {"metric": "m_e2e", "value": 50.0}}
+    flat, details = flatten(_wrapper(parsed=rec, tail_records=[rec]))
+    assert flat == {"m_step": 100.0, "m_step/final_loss": 4.2,
+                    "m_step/input": 500.0, "m_e2e": 50.0}
+    assert details == 2  # noted, never gated
+
+
+def test_flatten_takes_last_record_per_metric_and_skips_garbage():
+    text = "\n".join([
+        "not json",
+        json.dumps({"metric": "m", "value": 10.0}),  # provisional line
+        json.dumps({"no_metric": True}),
+        json.dumps({"metric": "m", "value": 30.0}),  # consolidated: wins
+    ])
+    flat, _ = flatten(text)
+    assert flat == {"m": 30.0}
+
+
+def test_flatten_failed_round_is_empty():
+    flat, _ = flatten(_wrapper(parsed=None, rc=1))
+    assert flat == {}
+    # a zero/fallback value record carries no perf claim either
+    flat, _ = flatten(_wrapper(parsed={"metric": "m", "value": 0.0}))
+    assert flat == {}
+
+
+# ---------------------------------------------------------------------------
+# gate semantics
+# ---------------------------------------------------------------------------
+
+_TRAJ = [
+    ("r1", {"m": 100.0, "m/final_loss": 4.0}),
+    ("r2", {"m": 120.0}),  # newest baseline for m
+]
+
+
+def test_gate_pass_improvement_and_regression():
+    ok = gate_record({"m": 115.0}, _TRAJ, tolerance=0.25)
+    assert not ok["regressions"]
+    assert ok["passes"][0]["baseline_round"] == "r2"  # newest wins
+    up = gate_record({"m": 130.0}, _TRAJ, tolerance=0.25)
+    assert up["improvements"][0]["ratio"] == 1.0833
+    bad = gate_record({"m": 80.0}, _TRAJ, tolerance=0.25)
+    (reg,) = bad["regressions"]
+    assert reg["baseline"] == 120.0 and reg["tolerance"] == 0.25
+    # exactly at the tolerance edge: not a regression
+    edge = gate_record({"m": 90.0}, _TRAJ, tolerance=0.25)
+    assert not edge["regressions"]
+
+
+def test_gate_loss_is_lower_better():
+    ok = gate_record({"m/final_loss": 4.3}, _TRAJ, loss_tolerance=0.10)
+    assert not ok["regressions"]
+    bad = gate_record({"m/final_loss": 4.5}, _TRAJ, loss_tolerance=0.10)
+    assert bad["regressions"][0]["metric"] == "m/final_loss"
+    better = gate_record({"m/final_loss": 3.5}, _TRAJ, loss_tolerance=0.10)
+    assert better["improvements"]
+
+
+def test_gate_new_metric_has_no_baseline():
+    out = gate_record({"m_new": 5.0}, _TRAJ)
+    assert out["new_metrics"] == ["m_new"]
+    assert out["compared"] == 0
+
+
+def test_gate_per_metric_override():
+    out = gate_record({"m": 110.0}, _TRAJ, tolerance=0.25,
+                      overrides={"m": 0.05})
+    (reg,) = out["regressions"]  # 110 < 120 * 0.95
+    assert reg["tolerance"] == 0.05
+
+
+# ---------------------------------------------------------------------------
+# trajectory loading + the committed-history self-test (tier-1 pin)
+# ---------------------------------------------------------------------------
+
+
+def test_load_trajectory_is_round_ordered():
+    names = [name for name, _ in load_trajectory()]
+    assert names == sorted(names)
+    assert names[0].startswith("BENCH_r0")
+
+
+def test_self_test_replays_committed_history_zero_false_regressions():
+    verdict = self_test()
+    # the committed trajectory must gate clean under DEFAULT tolerances —
+    # this is the pin that keeps the defaults honest against real history
+    assert verdict["regressions"] == 0
+    assert verdict["compared"] >= 1  # and it actually compared something
+    # the infra-failed rounds (r02 dead backend, r03 rc=124) were skipped
+    assert "BENCH_r02.json" in verdict["skipped"]
+    assert "BENCH_r03.json" in verdict["skipped"]
+    assert verdict["usable_rounds"] >= 3
+
+
+def test_self_test_cli_exit_codes(tmp_path):
+    out = subprocess.run([sys.executable, GATE, "--self-test"],
+                         capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert "0 regression(s)" in out.stdout
+    # a doctored trajectory WITH a real regression makes the self-test
+    # fail — the zero above is not vacuous
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        _wrapper(parsed={"metric": "m", "value": 100.0})))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        _wrapper(parsed={"metric": "m", "value": 10.0})))
+    out = subprocess.run(
+        [sys.executable, GATE, "--self-test", "--trajectory",
+         str(tmp_path / "BENCH_r*.json")],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 1
+
+
+def test_cli_gates_fresh_record(tmp_path):
+    fresh = tmp_path / "fresh.txt"
+    fresh.write_text(json.dumps(
+        {"metric": "moco_v2_r50_pretrain_throughput_per_chip",
+         "value": 1800.0}) + "\n")
+    out = subprocess.run([sys.executable, GATE, str(fresh), "--json"],
+                         capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    verdict = json.loads(out.stdout)
+    assert verdict["compared"] == 1 and not verdict["regressions"]
+    # a 10× drop fails the gate loudly
+    fresh.write_text(json.dumps(
+        {"metric": "moco_v2_r50_pretrain_throughput_per_chip",
+         "value": 180.0}) + "\n")
+    out = subprocess.run([sys.executable, GATE, str(fresh)],
+                         capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 1
+    assert "REGRESSION" in out.stdout and "FAIL" in out.stdout
+
+
+def test_cli_failed_fresh_bench_and_usage(tmp_path):
+    empty = tmp_path / "empty.txt"
+    empty.write_text("no metrics here\n")
+    out = subprocess.run([sys.executable, GATE, str(empty)],
+                         capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 1  # a metric-less fresh bench IS a failure
+    out = subprocess.run([sys.executable, GATE, str(empty),
+                          "--allow-failed"],
+                         capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0
+    out = subprocess.run([sys.executable, GATE],
+                         capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 2
+    out = subprocess.run([sys.executable, GATE, str(empty),
+                          "--tolerance-for", "garbage"],
+                         capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 2

@@ -1,0 +1,1039 @@
+"""obsd: fleet-wide aggregation + SLO/burn-rate engine (ISSUE 12).
+
+  - pure units: PercentileWindow ring math, StreamTailer partial-line /
+    truncation discipline, RunWindow objective folds, SLORule validation
+  - burn-rate engine: fast+slow gating, for_s arming, clear_s recovery
+    hysteresis, one alert per sustained incident (no flapping)
+  - HTTP contract: /metrics is valid Prometheus text exposition 0.0.4,
+    /slo and /runs are schema-stable JSON — probed over real HTTP
+  - heartbeat monotonic pair (satellite): seq/mono_s written by every
+    beat; the supervisor's freshness/change checks prefer them, so a
+    wall-clock step reads as neither hang nor freshness
+  - router_stats schema (satellite): the autoscaler input record carries
+    cumulative per-code sheds, outstanding depth, latency percentiles
+  - import diet: aggregate.py + tools/obsd.py run with jax/numpy blocked
+    (subprocess, like trace.py's — mocolint R11 obsd-stdlib-only)
+  - THE acceptance smoke: 30-step CPU train with chaos slow_at_step
+    while a 2-replica stub fleet serves load, ONE obsd tailing both →
+    the step-time SLO fires exactly one alert then one recovery,
+    /metrics + /slo stay valid during the run, the slo records land
+    under the producing run_ids, and telemetry_report renders `slo:`
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from moco_tpu.telemetry.aggregate import (
+    Aggregator,
+    ObsServer,
+    PercentileWindow,
+    RunWindow,
+    SLOEngine,
+    SLORule,
+    StreamTailer,
+    discover_streams,
+    load_rules,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPORT = os.path.join(REPO, "tools", "telemetry_report.py")
+
+
+# ---------------------------------------------------------------------------
+# percentile window
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_window_nearest_rank_and_ring():
+    w = PercentileWindow(size=4)
+    assert w.percentile(95) == 0.0  # empty: 0, never raises
+    for v in (0.010, 0.020, 0.030, 0.040):
+        w.observe(v)
+    assert w.percentile(50) == pytest.approx(0.030)
+    assert w.percentile(99) == pytest.approx(0.040)
+    # ring: a 5th observation evicts the oldest
+    w.observe(0.050)
+    assert w.count == 4
+    assert w.percentile(0) == pytest.approx(0.020)
+    pct = w.percentiles_ms()
+    assert set(pct) == {"p50", "p95", "p99"}
+    assert pct["p50"] <= pct["p95"] <= pct["p99"] == 50.0
+
+
+def test_percentile_window_rejects_bad_size():
+    with pytest.raises(ValueError):
+        PercentileWindow(size=0)
+
+
+# ---------------------------------------------------------------------------
+# stream tailing
+# ---------------------------------------------------------------------------
+
+
+def test_tailer_partial_line_and_truncation(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    t = StreamTailer(path)
+    assert t.poll() == []  # missing file: "not yet", never an error
+    with open(path, "w") as f:
+        f.write('{"kind": "step", "step": 1}\n{"kind": "st')
+        f.flush()
+    recs = t.poll()
+    assert [r["step"] for r in recs] == [1]  # torn tail stays buffered
+    with open(path, "a") as f:
+        f.write('ep", "step": 2}\n')
+    recs = t.poll()
+    assert [r["step"] for r in recs] == [2]  # completed across two polls
+    # truncation resets the offset and re-reads from the top
+    with open(path, "w") as f:
+        f.write('{"kind": "step", "step": 9}\nnot json at all\n')
+    recs = t.poll()
+    assert [r["step"] for r in recs] == [9]
+    assert t.skipped == 1  # the garbage line counted, not fatal
+
+
+def test_discover_streams_fleet_layout(tmp_path):
+    fleet = tmp_path / "fleet"
+    (fleet / "replica0").mkdir(parents=True)
+    (fleet / "replica1").mkdir()
+    (fleet / "not_a_replica").mkdir()
+    (fleet / "events.jsonl").write_text("")
+    (fleet / "replica0" / "events.jsonl").write_text("")
+    (fleet / "replica1" / "events.jsonl").write_text("")
+    (fleet / "not_a_replica" / "events.jsonl").write_text("")
+    lone = tmp_path / "train.jsonl"
+    lone.write_text("")
+    streams = discover_streams([str(fleet), str(lone)])
+    labels = sorted(os.path.basename(k.rstrip("/")) for k in streams)
+    assert labels == ["fleet", "replica0", "replica1", "train.jsonl"]
+
+
+# ---------------------------------------------------------------------------
+# run-window objective folds
+# ---------------------------------------------------------------------------
+
+
+def _step(step, step_s, data_s=0.0, mfu=None, run="r1"):
+    rec = {"v": 1, "t": time.time(), "kind": "step", "run_id": run,
+           "step": step, "step_s": step_s, "data_s": data_s}
+    if mfu is not None:
+        rec["mfu"] = mfu
+    return rec
+
+
+def test_run_window_step_metrics_and_min_step():
+    w = RunWindow("r1")
+    w.ingest(_step(1, 5.0), "src", "p", now=100.0)  # the compile step
+    for i in range(2, 12):
+        w.ingest(_step(i, 0.1, data_s=0.05, mfu=0.2), "src", "p",
+                 now=100.0 + i)
+    # min_step=0 sees the compile blowout; min_step=3 drops it AND the
+    # early steps (the SlowSampleDetector `skip` lesson)
+    assert w.metric("step_time_ms_max", 1000.0, 120.0) == 5000.0
+    assert w.metric("step_time_ms_max", 1000.0, 120.0, 3) == \
+        pytest.approx(100.0)
+    assert w.metric("step_time_ms_p50", 1000.0, 120.0, 3) == \
+        pytest.approx(100.0)
+    assert w.metric("data_share", 1000.0, 120.0, 3) == pytest.approx(0.5)
+    assert w.metric("mfu_mean", 1000.0, 120.0, 3) == pytest.approx(0.2)
+    # the TIME window is on the aggregator's observation clock: a narrow
+    # window sees only the recent steps
+    assert w.metric("step_time_ms_max", 3.0, 112.0) == pytest.approx(100.0)
+    # and an empty window answers None, never 0 (silence != healthy)
+    assert w.metric("step_time_ms_p95", 1.0, 500.0) is None
+    with pytest.raises(ValueError):
+        w.metric("no_such_objective", 10.0, 0.0)
+
+
+def test_run_window_shed_rate_from_router_deltas():
+    w = RunWindow("r1")
+
+    def router(now, requests, sheds):
+        w.ingest({"kind": "fleet", "event": "router_stats",
+                  "requests": requests, "shed_no_backend": sheds,
+                  "outstanding": 3,
+                  "latency_ms": {"p50": 1.0, "p95": 2.0, "p99": 3.0}},
+                 "fleet", "p", now)
+
+    assert w.metric("shed_rate", 60.0, 100.0) is None  # < 2 snapshots
+    router(100.0, 100, 0)
+    assert w.metric("shed_rate", 60.0, 100.0) is None
+    router(110.0, 300, 10)
+    # delta: 10 sheds / 200 requests inside the window
+    assert w.metric("shed_rate", 60.0, 115.0) == pytest.approx(0.05)
+    assert w.metric("outstanding", 60.0, 115.0) == 3.0
+    assert w.metric("router_latency_ms_p95", 60.0, 115.0) == 2.0
+    # counters are cumulative: a window covering only the LAST snapshot
+    # has one point -> None, not a fabricated rate
+    assert w.metric("shed_rate", 4.0, 115.0) is None
+
+
+def test_run_window_event_counts_and_slo_feedback_guard():
+    w = RunWindow("r1")
+    w.ingest({"kind": "event", "event": "rollback"}, "s", "p", 10.0)
+    w.ingest({"kind": "event", "event": "sentinel"}, "s", "p", 11.0)
+    w.ingest({"kind": "fleet", "event": "reload_quarantine"}, "s", "p", 12.0)
+    w.ingest({"kind": "supervisor", "event": "resize_relaunch"},
+             "s", "p", 13.0)
+    assert w.metric("rollback_events", 60.0, 20.0) == 2.0
+    assert w.metric("reload_failures", 60.0, 20.0) == 1.0
+    assert w.metric("resize_relaunches", 60.0, 20.0) == 1.0
+    assert w.metric("event:resize_relaunch", 60.0, 20.0) == 1.0
+    # time-windowed: far in the future they're gone
+    assert w.metric("rollback_events", 5.0, 1000.0) == 0.0
+    # kind:"slo" records NEVER feed back into the windows they were
+    # computed from — only the counter moves
+    w.ingest({"kind": "slo", "action": "alert", "rule": "x"}, "s", "p", 14.0)
+    assert w.slo_events == 1
+    assert "slo" not in w.kinds
+
+
+# ---------------------------------------------------------------------------
+# SLO rules + burn-rate engine
+# ---------------------------------------------------------------------------
+
+
+def test_slo_rule_validation():
+    with pytest.raises(ValueError):
+        SLORule({"name": "x", "objective": "step_time_ms_p95"})  # no threshold
+    with pytest.raises(ValueError):
+        SLORule({"name": "x", "objective": "o", "threshold": 1,
+                 "op": "!="})
+    with pytest.raises(ValueError):
+        SLORule({"name": "x", "objective": "o", "threshold": 1,
+                 "fast_window_s": 60, "slow_window_s": 30})
+    r = SLORule({"name": "x", "objective": "step_time_ms_p95",
+                 "threshold": 100})
+    assert r.op == ">" and r.slow_window_s == 5 * r.fast_window_s
+    assert r.min_step == 3  # compile steps excluded by default
+    assert r.clear_s == 2.0  # default hysteresis EXISTS: a metric at
+    # its threshold must not flap one alert/recovery pair per tick
+
+
+def test_default_clear_s_suppresses_tick_flap():
+    engine, windows = _engine_with_steps(
+        [(100.0, 2.0)], {"fast_window_s": 3, "slow_window_s": 6})
+    assert [t["action"] for t in engine.evaluate(windows, 101.0)] \
+        == ["alert"]
+    # the stall ages out at 103; with the 2 s default clear_s the very
+    # next clean tick must NOT already recover
+    assert engine.evaluate(windows, 103.5) == []
+    assert [t["action"] for t in engine.evaluate(windows, 106.0)] \
+        == ["recover"]
+
+
+def test_load_rules_default_set_and_file(tmp_path):
+    rules = load_rules(None)
+    names = {r.name for r in rules}
+    # the documented default set: step-time p95, data-stall share, shed
+    # rate, reload failure, NaN/rollback, resize loop
+    assert names == {"step_time_p95", "data_stall_share", "shed_rate",
+                     "reload_failure", "nonfinite_loss", "resize_loop"}
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps({"rules": [
+        {"name": "a", "objective": "step_time_ms_p95", "threshold": 5},
+    ]}))
+    assert [r.name for r in load_rules(str(path))] == ["a"]
+    path.write_text(json.dumps([
+        {"name": "a", "objective": "o", "threshold": 1},
+        {"name": "a", "objective": "o", "threshold": 2},
+    ]))
+    with pytest.raises(ValueError, match="duplicate"):
+        load_rules(str(path))
+    path.write_text("{}")
+    with pytest.raises(ValueError):
+        load_rules(str(path))
+
+
+def _engine_with_steps(step_s_by_time, rule_kw):
+    """One window fed with (now, step_s) samples + one rule engine."""
+    w = RunWindow("r1")
+    for i, (now, step_s) in enumerate(step_s_by_time):
+        w.ingest(_step(i + 10, step_s), "src", "p", now)
+    rule = SLORule({"name": "st", "objective": "step_time_ms_max",
+                    "op": ">", "threshold": 1000.0, **rule_kw})
+    return SLOEngine([rule]), {"r1": w}
+
+
+def test_burn_rate_needs_both_windows():
+    # fast window violated, slow window CLEAN -> no alert (a blip the
+    # slow window absorbs). Achieved via a steeper slow threshold.
+    engine, windows = _engine_with_steps(
+        [(100.0 + i, 0.1) for i in range(10)] + [(111.0, 2.0)],
+        {"fast_window_s": 5, "slow_window_s": 50,
+         "slow_threshold": 5000.0},
+    )
+    assert engine.evaluate(windows, 112.0) == []
+    st = engine.state_for("st", "r1")
+    assert not st.alerting
+    assert st.last_fast == pytest.approx(2000.0)
+
+
+def test_burn_rate_alert_for_s_and_recovery_hysteresis():
+    engine, windows = _engine_with_steps(
+        [(100.0, 2.0)],  # one 2 s stall
+        {"fast_window_s": 10, "slow_window_s": 20,
+         "for_s": 3.0, "clear_s": 4.0},
+    )
+    # violating but not yet sustained for for_s: armed, silent
+    assert engine.evaluate(windows, 101.0) == []
+    assert engine.evaluate(windows, 102.0) == []
+    out = engine.evaluate(windows, 104.5)  # 3.5 s sustained -> alert
+    assert [t["action"] for t in out] == ["alert"]
+    assert out[0]["rule"] == "st" and out[0]["run_id"] == "r1"
+    assert out[0]["value_fast"] == pytest.approx(2000.0)
+    # still violating: no re-alert
+    assert engine.evaluate(windows, 106.0) == []
+    # stall ages out of the fast window at t=110; clear_s=4 holds the
+    # recovery until the clean stretch is sustained
+    assert engine.evaluate(windows, 111.0) == []
+    assert engine.evaluate(windows, 113.0) == []
+    out = engine.evaluate(windows, 115.5)
+    assert [t["action"] for t in out] == ["recover"]
+    # fully drained: nothing else ever fires
+    assert engine.evaluate(windows, 200.0) == []
+    st = engine.state_for("st", "r1")
+    assert st.alerts == 1 and st.recoveries == 1
+
+
+def test_burn_rate_flap_within_for_s_rearms():
+    # a violation that clears before for_s elapses never alerts
+    engine, windows = _engine_with_steps(
+        [(100.0, 2.0)],
+        {"fast_window_s": 2, "slow_window_s": 4, "for_s": 5.0},
+    )
+    assert engine.evaluate(windows, 101.0) == []  # violating, arming
+    assert engine.evaluate(windows, 107.0) == []  # aged out before for_s
+    assert engine.evaluate(windows, 200.0) == []
+    assert engine.state_for("st", "r1").alerts == 0
+
+
+def test_engine_snapshot_shape():
+    engine, windows = _engine_with_steps(
+        [(100.0, 2.0)], {"fast_window_s": 10, "slow_window_s": 20})
+    engine.evaluate(windows, 101.0)
+    snap = engine.snapshot(windows)
+    (rule,) = snap["rules"]
+    assert rule["name"] == "st"
+    assert rule["runs"]["r1"]["state"] == "alert"
+    assert rule["runs"]["r1"]["alerts"] == 1
+    assert "since" in rule["runs"]["r1"]
+
+
+# ---------------------------------------------------------------------------
+# aggregator: multi-stream ingest + slo emission
+# ---------------------------------------------------------------------------
+
+
+def _write_lines(path, records):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "a") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_aggregator_emits_slo_into_producing_stream(tmp_path):
+    train = tmp_path / "train"
+    train.mkdir()
+    ev = str(train / "events.jsonl")
+    _write_lines(ev, [_step(i, 0.05) for i in range(4, 10)])
+    rules = [SLORule({"name": "st", "objective": "step_time_ms_max",
+                      "threshold": 1000.0, "fast_window_s": 5,
+                      "slow_window_s": 10})]
+    agg = Aggregator([str(train)], rules=rules)
+    assert agg.poll_once() == []
+    _write_lines(ev, [_step(11, 2.0)])
+    (transition,) = agg.poll_once()
+    assert transition["action"] == "alert"
+    # the record landed in the PRODUCING run's own stream, kind:"slo",
+    # under the producing run_id
+    slo_lines = [json.loads(line) for line in open(ev)
+                 if '"slo"' in line]
+    assert len(slo_lines) == 1
+    assert slo_lines[0]["kind"] == "slo"
+    assert slo_lines[0]["run_id"] == "r1"
+    assert slo_lines[0]["rule"] == "st"
+    # the appended line reads back without disturbing the alert state
+    assert agg.poll_once() == []
+    assert agg.windows["r1"].slo_events == 1
+
+
+def test_aggregator_no_emit_mode(tmp_path):
+    train = tmp_path / "train"
+    train.mkdir()
+    ev = str(train / "events.jsonl")
+    rules = [SLORule({"name": "st", "objective": "step_time_ms_max",
+                      "threshold": 1000.0, "fast_window_s": 5,
+                      "slow_window_s": 10})]
+    agg = Aggregator([str(train)], rules=rules, emit_slo=False)
+    agg.poll_once()  # tailer exists before the stall lands (live data)
+    _write_lines(ev, [_step(11, 2.0)])
+    (transition,) = agg.poll_once()
+    assert transition["action"] == "alert"
+    assert not [line for line in open(ev) if '"slo"' in line]
+    assert agg.windows["r1"].slo_events == 1  # still counted
+
+
+def test_aggregator_restart_does_not_replay_history(tmp_path):
+    """The restart story (review finding): a stream already containing
+    an incident AND its alert/recover pair is catch-up for a fresh
+    obsd — counters/meta fold, but the windows stay empty, no duplicate
+    alert is appended, and a NEW incident still fires."""
+    train = tmp_path / "train"
+    train.mkdir()
+    ev = str(train / "events.jsonl")
+    _write_lines(ev, [_step(i, 0.05) for i in range(4, 10)]
+                 + [_step(10, 2.0)]  # yesterday's stall
+                 + [{"kind": "slo", "action": "alert", "rule": "st",
+                     "run_id": "r1"},
+                    {"kind": "slo", "action": "recover", "rule": "st",
+                     "run_id": "r1"}])
+    rules = [SLORule({"name": "st", "objective": "step_time_ms_max",
+                      "threshold": 1000.0, "fast_window_s": 5,
+                      "slow_window_s": 10})]
+    agg = Aggregator([str(train)], rules=rules)
+    assert agg.poll_once() == []  # catch-up: NO duplicate alert
+    assert agg.poll_once() == []
+    window = agg.windows["r1"]
+    assert window.steps_total == 7          # history still counted
+    assert window.slo_events == 2
+    assert window.metric("step_time_ms_max", 1e9, time.monotonic()) \
+        is None                             # ...but not windowed
+    assert len([line for line in open(ev) if '"slo"' in line]) == 2
+    # a LIVE stall after the restart still alerts exactly once
+    _write_lines(ev, [_step(20, 2.0)])
+    (transition,) = agg.poll_once()
+    assert transition["action"] == "alert"
+
+
+def test_aggregator_discovers_late_replica_dirs(tmp_path):
+    fleet = tmp_path / "fleet"
+    fleet.mkdir()
+    _write_lines(str(fleet / "events.jsonl"),
+                 [{"kind": "fleet", "event": "fleet_start",
+                   "run_id": "f1", "replicas": 2}])
+    agg = Aggregator([str(fleet)], rules=[])
+    agg.poll_once()
+    assert agg.runs_snapshot()["streams"] == 1
+    # a replica dir that appears AFTER the aggregator started is tailed
+    _write_lines(str(fleet / "replica0" / "events.jsonl"),
+                 [{"kind": "serve", "run_id": "f1", "requests": 5,
+                   "served": 5, "latency_ms": {"p95": 3.0}}])
+    agg.poll_once()
+    snap = agg.runs_snapshot()
+    assert snap["streams"] == 2
+    (run,) = snap["runs"]
+    assert run["kinds"] == {"fleet": 1, "serve": 1}
+
+
+def test_aggregator_retires_dead_runs(tmp_path):
+    """Bounded state for an always-on daemon: an ended (or long-silent)
+    run's window AND rule state are dropped once nothing is alerting —
+    run_ids churn with every relaunch, and a watcher that only ever
+    gains windows degrades for its whole (long) life."""
+    train = tmp_path / "train"
+    train.mkdir()
+    ev = str(train / "events.jsonl")
+    rules = [SLORule({"name": "st", "objective": "step_time_ms_max",
+                      "threshold": 1000.0, "fast_window_s": 5,
+                      "slow_window_s": 10})]
+    agg = Aggregator([str(train)], rules=rules, retire_after_s=100.0)
+    now = time.monotonic()
+    agg.poll_once(now)
+    _write_lines(ev, [_step(5, 0.05), {"kind": "run_end", "run_id": "r1",
+                                       "steps": 5}])
+    agg.poll_once(now + 1.0)
+    assert "r1" in agg.windows
+    # ended + past the post-end grace -> retired, state gone
+    agg.poll_once(now + 70.0)
+    assert "r1" not in agg.windows
+    assert agg.retired == 1
+    assert not agg.engine._state
+    # a silent-but-never-ended run retires on retire_after_s
+    _write_lines(ev, [_step(6, 0.05, run="r2")])
+    agg.poll_once(now + 71.0)
+    assert "r2" in agg.windows
+    agg.poll_once(now + 180.0)
+    assert "r2" not in agg.windows
+    # an ALERTING run is never retired out from under its recovery
+    _write_lines(ev, [_step(7, 2.0, run="r3")])
+    agg.poll_once(now + 181.0)
+    assert agg.engine.state_for("st", "r3").alerting
+    agg.poll_once(now + 400.0)  # silent way past retire_after_s
+    assert "r3" in agg.windows  # still held: recovery must land first
+
+
+# ---------------------------------------------------------------------------
+# HTTP contract
+# ---------------------------------------------------------------------------
+
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+=\"[^\"]*\""
+    r"(,[a-zA-Z0-9_]+=\"[^\"]*\")*\})? -?[0-9.e+-]+$"
+)
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), resp.read().decode("utf-8")
+
+
+def validate_prometheus(text: str) -> dict:
+    """Assert `text` is well-formed exposition; return {metric: samples}."""
+    metrics: dict[str, int] = {}
+    typed: set[str] = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            assert len(parts) >= 4, line
+            if parts[1] == "TYPE":
+                assert parts[3] in ("gauge", "counter"), line
+                typed.add(parts[2])
+            continue
+        assert _PROM_SAMPLE.match(line), f"bad exposition line: {line!r}"
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        assert name in typed, f"sample before TYPE: {line!r}"
+        metrics[name] = metrics.get(name, 0) + 1
+    assert text.endswith("\n")
+    return metrics
+
+
+@pytest.fixture()
+def obs_http(tmp_path):
+    train = tmp_path / "train"
+    train.mkdir()
+    rules = [SLORule({"name": "st", "objective": "step_time_ms_max",
+                      "threshold": 1000.0, "fast_window_s": 5,
+                      "slow_window_s": 10})]
+    agg = Aggregator([str(train)], rules=rules)
+    agg.poll_once()  # tailer exists first: the records below are LIVE
+    _write_lines(str(train / "events.jsonl"),
+                 [{"v": 1, "t": time.time(), "kind": "run_start",
+                   "run_id": "r1", "name": "smoke", "arch": "tiny"}]
+                 + [_step(i, 0.05, data_s=0.01, mfu=0.3)
+                    for i in range(4, 10)]
+                 + [{"kind": "event", "event": "rollback", "run_id": "r1"},
+                    {"kind": "fleet", "event": "router_stats",
+                     "run_id": "r1", "requests": 10, "ok": 9,
+                     "shed_no_backend": 1, "outstanding": 2,
+                     "latency_ms": {"p50": 1.0, "p95": 2.0, "p99": 3.0}}])
+    agg.poll_once()
+    server = ObsServer(agg)
+    server.start()
+    try:
+        yield server, agg, str(train / "events.jsonl")
+    finally:
+        server.shutdown()
+
+
+def test_metrics_endpoint_valid_exposition(obs_http):
+    server, agg, _ = obs_http
+    status, headers, body = _get(server.url + "/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain; version=0.0.4")
+    metrics = validate_prometheus(body)
+    assert metrics["moco_tpu_steps_total"] == 1
+    assert metrics["moco_tpu_step_time_ms"] == 3  # p50/p95/p99
+    assert metrics["moco_tpu_events_total"] >= 1
+    assert metrics["moco_tpu_router_outstanding"] == 1
+    assert metrics["moco_tpu_router_requests_total"] == 1
+    assert metrics["moco_tpu_router_latency_ms"] == 3
+    assert metrics["moco_tpu_obsd_streams"] == 1
+    assert 'run_id="r1"' in body
+
+
+def test_slo_and_runs_endpoints_json(obs_http):
+    server, agg, _ = obs_http
+    status, headers, body = _get(server.url + "/slo")
+    assert status == 200
+    assert headers["Content-Type"] == "application/json"
+    slo = json.loads(body)
+    assert slo["v"] == 1
+    (rule,) = slo["rules"]
+    assert rule["name"] == "st"
+    assert rule["runs"]["r1"]["state"] == "ok"
+    status, _, body = _get(server.url + "/runs")
+    runs = json.loads(body)
+    assert runs["records"] == 9
+    (run,) = runs["runs"]
+    assert run["run_id"] == "r1"
+    assert run["run"]["name"] == "smoke"
+    assert run["steps"] == 6
+    assert "stale_s" in run
+    status, _, _ = _get(server.url + "/healthz")
+    assert status == 200
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(server.url + "/nope")
+    assert exc.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# heartbeat monotonic pair (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_writes_seq_and_mono(tmp_path):
+    from moco_tpu.telemetry.registry import Heartbeat
+
+    hb = Heartbeat(str(tmp_path / "heartbeat.json"))
+    hb.beat(1, phase="step")
+    first = json.load(open(tmp_path / "heartbeat.json"))
+    hb.beat(2, phase="step")
+    second = json.load(open(tmp_path / "heartbeat.json"))
+    assert first["seq"] == 1 and second["seq"] == 2
+    assert second["mono_s"] >= first["mono_s"] > 0
+    assert second["pid"] == os.getpid()
+
+
+def test_supervisor_staleness_prefers_monotonic_pair():
+    from moco_tpu.resilience.supervisor import beat_is_fresh, beat_marker
+
+    now_wall, now_mono = time.time(), time.monotonic()
+    # BACKWARD wall jump since our launch: the launch's wall stamp sits
+    # 100 s in the (new) future, so the wall comparison would call a
+    # LIVE child's current beat stale — the mono pair (same boot: the
+    # beat's t−mono_s offset matches ours) must win
+    launched_wall, launched_mono = now_wall + 100.0, now_mono - 10.0
+    live = {"t": now_wall, "mono_s": now_mono, "seq": 7}
+    assert beat_is_fresh(live, launched_wall, launched_mono)
+    # same boot, genuinely stale (previous incarnation, written 50 s
+    # before our launch): mono says stale even if a forward wall jump
+    # at launch time would confuse the wall comparison
+    stale = {"t": now_wall - 50.0, "mono_s": now_mono - 50.0}
+    assert not beat_is_fresh(stale, now_wall - 60.0, now_mono - 10.0)
+    # CROSS-HOST beat (srun wrapper on another node, shared FS): the
+    # writer's clock offset disagrees wildly, so CLOCK_MONOTONIC is
+    # incomparable — wall semantics (the pre-pair behavior) apply
+    foreign = {"t": now_wall + 1.0, "mono_s": 1234.5}
+    assert beat_is_fresh(foreign, now_wall, now_mono - 10.0)
+    foreign_stale = {"t": now_wall - 99.0, "mono_s": 1234.5}
+    assert not beat_is_fresh(foreign_stale, now_wall, now_mono - 10.0)
+    # no mono pair (old payload): wall fallback unchanged
+    assert beat_is_fresh({"t": now_wall + 1.0}, now_wall, now_mono)
+    assert not beat_is_fresh({"t": now_wall - 1.0}, now_wall, now_mono)
+    # change detection keys on seq when present (equal wall stamps from
+    # a coarse clock can no longer mask progress)
+    a = {"t": 100.0, "seq": 1}
+    b = {"t": 100.0, "seq": 2}
+    assert beat_marker(a) != beat_marker(b)
+    assert beat_marker({"t": 100.0}) == ("t", 100.0)
+    # a seq marker can never collide with a t marker
+    assert beat_marker({"seq": 100}) != beat_marker({"t": 100})
+
+
+# ---------------------------------------------------------------------------
+# router_stats schema (satellite): the stable autoscaler input
+# ---------------------------------------------------------------------------
+
+
+def test_router_stats_record_schema(tmp_path):
+    from moco_tpu.serve.fleet import FleetPolicy, FleetSupervisor
+
+    fleet = FleetSupervisor(
+        lambda *a: ["true"], replicas=1,
+        telemetry_dir=str(tmp_path / "fleet_t"),
+        policy=FleetPolicy(stats_every_secs=0.1), seed=0,
+    )
+    # no .start(): drive the counters directly and emit
+    fleet.r_requests, fleet.r_ok = 100, 90
+    fleet.r_shed_no_backend, fleet.r_upstream_timeout = 4, 3
+    fleet.r_upstream_error, fleet.r_deadline_router = 2, 1
+    for v in (0.010, 0.020, 0.030):
+        fleet._router_latency.observe(v)
+    fleet._emit_router_stats(final=True)
+    (rec,) = [json.loads(line)
+              for line in open(tmp_path / "fleet_t" / "events.jsonl")]
+    assert rec["kind"] == "fleet" and rec["event"] == "router_stats"
+    # the stable schema obsd + the autoscaler key on
+    for key in ("requests", "ok", "retries", "retry_ok",
+                "shed_no_backend", "upstream_timeout", "upstream_error",
+                "shed_deadline_router", "passthrough_non_200",
+                "outstanding", "healthy", "replicas", "interval_s",
+                "run_id"):
+        assert key in rec, key
+    assert rec["requests"] == 100 and rec["shed_deadline_router"] == 1
+    assert rec["latency_ms"]["p50"] == pytest.approx(20.0)
+    assert rec["window"] == 3
+    # report folds the new fields into the router section
+    from tools.telemetry_report import summarize
+
+    flt = summarize([rec])["fleet"]
+    assert flt["router"]["outstanding"] == 0
+    assert flt["router"]["latency_ms"]["p95"] == pytest.approx(30.0)
+    assert flt["router"]["shed_rate"] == pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# report: slo section + follow line
+# ---------------------------------------------------------------------------
+
+
+def test_report_renders_slo_section(tmp_path):
+    from tools.telemetry_report import render, render_record, summarize
+
+    records = [
+        _step(5, 0.05),
+        {"v": 1, "t": 1.0, "kind": "slo", "action": "alert",
+         "rule": "step_time_p95", "objective": "step_time_ms_p95",
+         "op": ">", "threshold": 500.0, "run_id": "r1",
+         "value_fast": 2000.0, "value_slow": 1500.0},
+        {"v": 1, "t": 2.0, "kind": "slo", "action": "recover",
+         "rule": "step_time_p95", "objective": "step_time_ms_p95",
+         "run_id": "r1", "value_fast": 50.0},
+    ]
+    summary = summarize(records)
+    assert summary["slo"]["alerts"] == 1
+    assert summary["slo"]["recoveries"] == 1
+    assert summary["slo"]["active"] == []
+    rule = summary["slo"]["by_rule"]["step_time_p95"]
+    assert rule["alerts"] == 1 and not rule["active"]
+    text = render(summary)
+    assert "slo: 1 alert(s), 1 recovery(ies) — all clear" in text
+    assert "step_time_p95: 1 alert(s) / 1 recovery(ies)" in text
+    # an unrecovered alert shows ACTIVE
+    summary2 = summarize(records[:2])
+    assert summary2["slo"]["active"] == ["step_time_p95"]
+    assert "ACTIVE: step_time_p95" in render(summary2)
+    # --follow renders slo lines like fleet/resize ones
+    line = render_record(records[1])
+    assert line.startswith("slo: ALERT step_time_p95")
+    assert "step_time_ms_p95=2000.0" in line and "run=r1" in line
+
+
+# ---------------------------------------------------------------------------
+# import diet: aggregate + obsd without jax/numpy (subprocess)
+# ---------------------------------------------------------------------------
+
+
+def test_obsd_imports_without_jax_or_numpy(tmp_path):
+    events = tmp_path / "t" / "events.jsonl"
+    events.parent.mkdir()
+    events.write_text(json.dumps(
+        {"v": 1, "t": 0.0, "kind": "step", "run_id": "r", "step": 5,
+         "step_s": 0.05, "data_s": 0.01}) + "\n")
+    code = textwrap.dedent(f"""
+        import sys
+        class Block:
+            def find_module(self, name, path=None):
+                root = name.split('.')[0]
+                if root in ('jax', 'jaxlib', 'numpy', 'flax', 'optax',
+                            'orbax', 'scipy'):
+                    raise ImportError('blocked heavy import: ' + name)
+        sys.meta_path.insert(0, Block())
+        from moco_tpu.telemetry.aggregate import Aggregator, load_rules
+        agg = Aggregator([{str(tmp_path / 't')!r}], rules=load_rules(None))
+        agg.poll_once()
+        assert agg.runs_snapshot()['records'] == 1
+        assert 'moco_tpu_steps_total' in agg.prometheus()
+        import tools.obsd
+        print('CLEAN')
+    """)
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "CLEAN" in out.stdout
+
+
+def test_obsd_cli_once_mode(tmp_path):
+    events = tmp_path / "t" / "events.jsonl"
+    events.parent.mkdir()
+    events.write_text(json.dumps(
+        {"v": 1, "t": 0.0, "kind": "step", "run_id": "r", "step": 5,
+         "step_s": 0.05}) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obsd.py"),
+         str(tmp_path / "t"), "--once"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    snap = json.loads(out.stdout)
+    assert snap["records"] == 1
+    # a bad rule file is a config error (45), not a traceback
+    bad = tmp_path / "rules.json"
+    bad.write_text("{}")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obsd.py"),
+         str(tmp_path / "t"), "--once", "--rules", str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 45
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance smoke (ISSUE 12): train + stub fleet under ONE obsd
+# ---------------------------------------------------------------------------
+
+_STUB_REPLICA = textwrap.dedent("""\
+    import argparse, json, threading, time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--telemetry-dir", required=True)
+    p.add_argument("--pretrained", default="boot")
+    args, _ = p.parse_known_args()
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        def log_message(self, *a):
+            pass
+        def _send(self, status, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        def do_GET(self):
+            self._send(200, {"status": "ok"})
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            self._send(200, {"embedding": [1.0], "cached": False})
+
+    class S(ThreadingHTTPServer):
+        daemon_threads = True
+
+    S(("127.0.0.1", args.port), H).serve_forever()
+""")
+
+
+@pytest.fixture(scope="module")
+def obsd_smoke(mesh8, tmp_path_factory):
+    """30-step CPU train with a chaos slow step at 20, a 2-replica stub
+    fleet taking load, and ONE obsd tailing both telemetry dirs with a
+    step-time SLO sized so the 2 s stall (and nothing else) trips it.
+    obsd is a pure reader: the producers never know it exists."""
+    from moco_tpu.config import get_preset
+    from moco_tpu.serve.fleet import FleetPolicy, FleetSupervisor
+    from moco_tpu.train import train
+
+    tmp_path = tmp_path_factory.mktemp("obsd_smoke")
+    train_dir = tmp_path / "train_telemetry"
+    fleet_dir = tmp_path / "fleet_telemetry"
+
+    # --- the stub fleet under load -------------------------------------
+    stub = tmp_path / "stub_replica.py"
+    stub.write_text(_STUB_REPLICA)
+
+    def child_argv(index, port, tdir, pretrained):
+        return [sys.executable, str(stub), "--port", str(port),
+                "--telemetry-dir", tdir]
+
+    fleet = FleetSupervisor(
+        child_argv, replicas=2, telemetry_dir=str(fleet_dir),
+        policy=FleetPolicy(
+            probe_secs=0.1, probe_timeout_s=1.0, startup_grace_secs=30.0,
+            term_grace_secs=1.0, stats_every_secs=0.4,
+        ),
+        seed=0,
+    )
+    fleet.start()
+    # load starts only against a healthy fleet: startup sheds would
+    # (correctly!) fire the shed-rate SLO and muddy the exactly-one
+    # step-time story this smoke pins
+    deadline = time.monotonic() + 30.0
+    while fleet.healthy_count() < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert fleet.healthy_count() == 2
+
+    # --- one obsd over BOTH dirs ---------------------------------------
+    rules = [
+        SLORule({"name": "step_time", "objective": "step_time_ms_max",
+                 "op": ">", "threshold": 1500.0, "min_step": 3,
+                 "fast_window_s": 8.0, "slow_window_s": 60.0,
+                 "clear_s": 1.0, "severity": "page"}),
+        SLORule({"name": "shed_rate", "objective": "shed_rate",
+                 "op": ">", "threshold": 0.05,
+                 "fast_window_s": 8.0, "slow_window_s": 60.0}),
+    ]
+    agg = Aggregator([str(train_dir), str(fleet_dir)], rules=rules)
+    server = ObsServer(agg)
+    server.start()
+    stop = threading.Event()
+    collector = threading.Thread(
+        target=agg.run, kwargs=dict(tick_secs=0.2, stop=stop), daemon=True)
+    collector.start()
+
+    # --- live probes: /metrics + /slo must answer DURING the run -------
+    probes = {"metrics": [], "slo": [], "errors": []}
+
+    def probe_loop():
+        while not stop.is_set():
+            try:
+                _, _, metrics_body = _get(server.url + "/metrics")
+                _, _, slo_body = _get(server.url + "/slo")
+                probes["metrics"].append(metrics_body)
+                probes["slo"].append(json.loads(slo_body))
+            except Exception as e:  # noqa: BLE001 - recorded for assert
+                probes["errors"].append(repr(e))
+            stop.wait(0.5)
+
+    prober = threading.Thread(target=probe_loop, daemon=True)
+    prober.start()
+
+    def load_loop():
+        body = json.dumps({"pixels": [[[0, 0, 0]]]}).encode()
+        while not stop.is_set():
+            try:
+                req = urllib.request.Request(
+                    fleet.router.url + "/v1/embed", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                urllib.request.urlopen(req, timeout=5.0).read()
+            except Exception:  # noqa: BLE001 - load gen best-effort
+                pass
+            stop.wait(0.05)
+
+    loader = threading.Thread(target=load_loop, daemon=True)
+    loader.start()
+
+    # --- the 30-step chaos train (blocking) ----------------------------
+    config = get_preset("cifar10-moco-v1").replace(
+        arch="resnet_tiny", dataset="synthetic", image_size=16,
+        batch_size=16, num_negatives=64, embed_dim=32, lr=0.1, epochs=2,
+        steps_per_epoch=15, ckpt_dir="", tb_dir="", print_freq=10,
+        num_classes=10, knn_monitor=False,
+        telemetry_dir=str(train_dir), telemetry_flush_steps=2,
+        telemetry_stride=5, peak_flops_per_chip=1e12,
+        chaos="slow_at_step=20,slow_ms=2000",
+    )
+    state, metrics = train(config, mesh8)
+
+    # --- drain: keep ticking until the stall ages out and recovery
+    # fires (fast window 8 s + clear 1 s; generous deadline, tight poll)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        snap = agg.slo_snapshot()
+        st = next((r for r in snap["rules"] if r["name"] == "step_time"),
+                  None)
+        runs = (st or {}).get("runs", {})
+        if runs and all(r["state"] == "ok" and r["recoveries"] >= 1
+                        for r in runs.values()):
+            break
+        time.sleep(0.2)
+    stop.set()
+    collector.join(timeout=10.0)
+    prober.join(timeout=10.0)
+    loader.join(timeout=10.0)
+    agg.poll_once()
+    fleet.stop(timeout_s=10.0)
+    server.shutdown()
+    return dict(config=config, state=state, agg=agg, fleet=fleet,
+                probes=probes, train_dir=str(train_dir),
+                fleet_dir=str(fleet_dir))
+
+
+def test_smoke_exactly_one_alert_then_recovery(obsd_smoke):
+    assert int(obsd_smoke["state"].step) == 30
+    # the engine's final word: one alert, one recovery, state ok
+    snap = obsd_smoke["agg"].slo_snapshot()
+    st = next(r for r in snap["rules"] if r["name"] == "step_time")
+    (run_state,) = st["runs"].values()
+    assert run_state["alerts"] == 1
+    assert run_state["recoveries"] == 1
+    assert run_state["state"] == "ok"
+    # and the stream agrees: alert then recover, in order, kind:"slo"
+    events = os.path.join(obsd_smoke["train_dir"], "events.jsonl")
+    slo = [json.loads(line) for line in open(events) if '"slo"' in line]
+    slo = [r for r in slo if r.get("kind") == "slo"]
+    assert [r["action"] for r in slo] == ["alert", "recover"]
+    assert all(r["rule"] == "step_time" for r in slo)
+    assert slo[0]["value_fast"] >= 1500.0
+    # under the PRODUCING run id (the train driver's)
+    run_ids = {json.loads(line).get("run_id") for line in open(events)}
+    assert {r["run_id"] for r in slo} <= run_ids
+    # the fleet stream got NO step-time slo records (wrong run)
+    fleet_events = os.path.join(obsd_smoke["fleet_dir"], "events.jsonl")
+    assert not [line for line in open(fleet_events)
+                if '"kind": "slo"' in line]
+
+
+def test_smoke_endpoints_valid_during_run(obsd_smoke):
+    probes = obsd_smoke["probes"]
+    assert not probes["errors"], probes["errors"]
+    assert len(probes["metrics"]) >= 3  # actually sampled during the run
+    for body in probes["metrics"]:
+        validate_prometheus(body)
+    # the last mid-run scrapes carry both producers' series
+    assert any("moco_tpu_router_requests_total" in body
+               and "moco_tpu_steps_total" in body
+               for body in probes["metrics"][-3:])
+    for snap in probes["slo"]:
+        assert {r["name"] for r in snap["rules"]} == {"step_time",
+                                                      "shed_rate"}
+    # the alert was OBSERVABLE live on /slo at some point
+    assert any(
+        any(run.get("state") == "alert"
+            for run in next(r for r in snap["rules"]
+                            if r["name"] == "step_time")["runs"].values())
+        for snap in probes["slo"]
+    )
+
+
+def test_smoke_fleet_served_and_router_stats_flowed(obsd_smoke):
+    agg = obsd_smoke["agg"]
+    fleet = obsd_smoke["fleet"]
+    stats = fleet.stats()
+    assert stats["router"]["requests"] > 0
+    assert stats["router"]["ok"] > 0
+    # obsd folded the fleet's router_stats cadence records
+    fleet_run = agg.windows.get(fleet.run_id)
+    assert fleet_run is not None
+    assert fleet_run.last_router is not None
+    assert fleet_run.last_router["requests"] > 0
+    assert "latency_ms" in fleet_run.last_router
+    # and the shed-rate rule saw data without firing (healthy fleet)
+    st = next(r for r in agg.slo_snapshot()["rules"]
+              if r["name"] == "shed_rate")
+    run_state = st["runs"].get(fleet.run_id)
+    assert run_state is not None and run_state["alerts"] == 0
+
+
+def test_smoke_report_renders_slo_section(obsd_smoke):
+    events = os.path.join(obsd_smoke["train_dir"], "events.jsonl")
+    proc = subprocess.run(
+        [sys.executable, REPORT, events], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "slo: 1 alert(s), 1 recovery(ies) — all clear" in proc.stdout
+    assert "step_time:" in proc.stdout
+    as_json = subprocess.run(
+        [sys.executable, REPORT, events, "--json"],
+        capture_output=True, text=True)
+    summary = json.loads(as_json.stdout)
+    assert summary["slo"]["alerts"] == 1
+    assert summary["slo"]["by_rule"]["step_time"]["severity"] == "page"
+
+
+def test_smoke_obsd_is_a_pure_reader(obsd_smoke):
+    """The overhead bound, structurally: obsd never writes producer
+    files except the slo lines, and the producers' own record streams
+    parse cleanly after a full run of concurrent tailing (no torn
+    lines, no interleave corruption)."""
+    from tools.telemetry_report import load_events
+
+    for dirname in (obsd_smoke["train_dir"], obsd_smoke["fleet_dir"]):
+        records, skipped = load_events(
+            os.path.join(dirname, "events.jsonl"))
+        assert skipped == 0
+        assert records
+    # every non-slo record in the train stream was written by the run's
+    # own producers (driver pid): obsd added nothing but slo lines
+    train_records, _ = load_events(
+        os.path.join(obsd_smoke["train_dir"], "events.jsonl"))
+    foreign = [r for r in train_records
+               if r.get("kind") not in (
+                   "run_start", "step", "event", "run_end", "pod", "slo")]
+    assert foreign == []

@@ -1,0 +1,365 @@
+#!/usr/bin/env python
+"""bench_gate — fail loudly when a fresh BENCH record regresses the
+committed trajectory (ISSUE 12).
+
+    python bench.py | tee bench_out.txt
+    python tools/bench_gate.py bench_out.txt          # vs BENCH_r*.json
+    python tools/bench_gate.py --self-test            # replay r01..r05
+
+The repo commits one `BENCH_r<k>.json` per round (`{"n", "cmd", "rc",
+"tail", "parsed"}` — the driver's wrapper around bench.py's stdout).
+Until now nothing COMPARED consecutive rounds: a 20% throughput drop
+lands as just another number and drifts silently. This gate:
+
+  - flattens every metric-bearing JSON line of a record's output into
+    `{metric_key: value}` (the headline `value`, folded `input.value` as
+    `<metric>/input`, the `e2e` record under its own metric name, and
+    `final_loss` as `<metric>/final_loss`). Noisy per-thread `detail`
+    rows are deliberately NOT gated (PR 3 measured them swinging 2× with
+    container core allocation) — they are counted and noted.
+  - for each fresh key, finds the NEWEST committed record carrying the
+    same key (rounds change metric names when the environment degrades —
+    a tiny-CPU-proxy number must never be compared against an 8-chip
+    one) and applies a per-metric tolerance: throughput-like keys may
+    drop at most `--tolerance` (default 25% — sandbox container variance
+    is real; see BENCH_r04 vs r01), `final_loss` may rise at most
+    `--loss-tolerance` (default 10%).
+  - rounds whose `parsed` is null (rc!=0 — an infra failure, e.g. r02's
+    dead TPU backend, r03's rc=124 timeout) contribute no baselines and,
+    in the self-test, are skipped: an infra-failed round records an
+    outage, not a perf claim. A FRESH record that failed is still a gate
+    FAILURE (`--allow-failed` opts out for degraded environments).
+
+`--self-test` replays the committed trajectory in order (each round
+gated against all earlier ones) and exits 1 on any false regression —
+the tier-1 pin that keeps the default tolerances honest against real
+history.
+
+Exit codes: 0 pass · 1 regression (or failed fresh bench) · 2 usage.
+Pure stdlib; also importable (`gate_record`) by bench.py's `--gate`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import json
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAJECTORY_GLOB = "BENCH_r*.json"
+
+# metric-key suffixes that are LOWER-better; everything else is a
+# throughput-like higher-better number
+_LOWER_BETTER = ("/final_loss",)
+
+DEFAULT_TOLERANCE = 0.25       # allowed relative drop (higher-better)
+DEFAULT_LOSS_TOLERANCE = 0.10  # allowed relative rise (lower-better)
+
+
+def _iter_metric_records(source) -> list[dict]:
+    """Every metric-bearing JSON object in a bench output. `source` is a
+    BENCH wrapper dict, a bare parsed record, or raw stdout text."""
+    if isinstance(source, dict):
+        if "metric" in source:
+            return [source]
+        records = []
+        tail = source.get("tail")
+        if isinstance(tail, str):
+            records.extend(_iter_metric_records(tail))
+        parsed = source.get("parsed")
+        if (isinstance(parsed, dict) and "metric" in parsed
+                and parsed["metric"] not in
+                {r["metric"] for r in records}):
+            # the wrapper's parsed IS the tail's last line; include it
+            # only when a truncated tail lost that line
+            records.append(parsed)
+        return records
+    records = []
+    for line in str(source).splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            records.append(rec)
+    return records
+
+
+def flatten(source) -> tuple[dict, int]:
+    """(metric_key -> value, skipped_detail_rows). Later records win on
+    key collision (bench.py prints provisional lines first and the
+    consolidated record LAST — the same convention every consumer
+    applies)."""
+    flat: dict[str, float] = {}
+    details = 0
+    for rec in _iter_metric_records(source):
+        name = str(rec["metric"])
+        value = rec.get("value")
+        if isinstance(value, (int, float)) and value > 0:
+            flat[name] = float(value)
+        loss = rec.get("final_loss")
+        if isinstance(loss, (int, float)):
+            flat[f"{name}/final_loss"] = float(loss)
+        inp = rec.get("input")
+        if isinstance(inp, dict):
+            v = inp.get("value")
+            if isinstance(v, (int, float)) and v > 0:
+                flat[f"{name}/input"] = float(v)
+            details += len(inp.get("detail") or ())
+        e2e = rec.get("e2e")
+        if isinstance(e2e, dict):
+            v = e2e.get("value")
+            ename = str(e2e.get("metric", f"{name}/e2e"))
+            if isinstance(v, (int, float)) and v > 0:
+                flat[ename] = float(v)
+    return flat, details
+
+
+def load_trajectory(pattern: str | None = None) -> list[tuple[str, dict]]:
+    """[(round_name, wrapper_dict)] sorted by round number then name —
+    oldest first. Unreadable files are skipped (a gate must judge perf,
+    not the repo's file hygiene)."""
+    pattern = pattern or os.path.join(REPO_ROOT, TRAJECTORY_GLOB)
+    entries = []
+    for path in globlib.glob(pattern):
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(data, dict):
+            entries.append((os.path.basename(path), data))
+
+    def key(entry):
+        m = re.search(r"(\d+)", entry[0])
+        return (int(m.group(1)) if m else 0, entry[0])
+
+    return sorted(entries, key=key)
+
+
+def load_trajectory_flats(pattern: str | None = None) -> list[tuple[str, dict]]:
+    """The trajectory as gate_record wants it: [(round_name, flat)]
+    oldest first, infra-failed (metric-less) rounds dropped — ONE place
+    for that rule, shared by this CLI and `bench.py --gate`."""
+    flats = [(name, flatten(wrapper)[0])
+             for name, wrapper in load_trajectory(pattern)]
+    return [(name, flat) for name, flat in flats if flat]
+
+
+def gate_record(fresh_flat: dict, trajectory_flats: list[tuple[str, dict]],
+                *, tolerance: float = DEFAULT_TOLERANCE,
+                loss_tolerance: float = DEFAULT_LOSS_TOLERANCE,
+                overrides: dict | None = None) -> dict:
+    """Compare one flattened record against the flattened trajectory
+    (oldest first). Returns the verdict dict (the --json payload):
+    `regressions` non-empty == gate failure. `overrides` maps metric_key
+    -> tolerance fraction."""
+    overrides = overrides or {}
+    regressions, improvements, passes, new_metrics = [], [], [], []
+    for key, value in sorted(fresh_flat.items()):
+        baseline = None
+        for round_name, flat in reversed(trajectory_flats):
+            if key in flat:
+                baseline = (round_name, flat[key])
+                break
+        if baseline is None:
+            new_metrics.append(key)
+            continue
+        round_name, base = baseline
+        lower_better = key.endswith(_LOWER_BETTER)
+        tol = overrides.get(
+            key, loss_tolerance if lower_better else tolerance)
+        entry = {
+            "metric": key,
+            "value": value,
+            "baseline": base,
+            "baseline_round": round_name,
+            "tolerance": tol,
+            "ratio": round(value / base, 4) if base else None,
+        }
+        if lower_better:
+            if value > base * (1.0 + tol):
+                regressions.append(entry)
+            elif value < base:
+                improvements.append(entry)
+            else:
+                passes.append(entry)
+        else:
+            if value < base * (1.0 - tol):
+                regressions.append(entry)
+            elif value > base:
+                improvements.append(entry)
+            else:
+                passes.append(entry)
+    return {
+        "compared": len(regressions) + len(improvements) + len(passes),
+        "regressions": regressions,
+        "improvements": improvements,
+        "passes": passes,
+        "new_metrics": new_metrics,
+    }
+
+
+def self_test(pattern: str | None = None, *,
+              tolerance: float = DEFAULT_TOLERANCE,
+              loss_tolerance: float = DEFAULT_LOSS_TOLERANCE) -> dict:
+    """Replay the committed trajectory: every non-null round gated
+    against all earlier rounds. Returns {"rounds": [...], "regressions":
+    N, "compared": N, "skipped": [names]} — regressions must be 0 for
+    the committed history (the tier-1 pin)."""
+    trajectory = load_trajectory(pattern)
+    if not trajectory:
+        raise FileNotFoundError(
+            f"no trajectory records match "
+            f"{pattern or os.path.join(REPO_ROOT, TRAJECTORY_GLOB)}"
+        )
+    flats: list[tuple[str, dict]] = []
+    rounds, skipped = [], []
+    compared = regressions = 0
+    for name, wrapper in trajectory:
+        flat, _ = flatten(wrapper)
+        if not flat:
+            skipped.append(name)  # infra-failed round: an outage record,
+            continue              # not a perf claim — never a baseline
+        if flats:
+            verdict = gate_record(flat, flats, tolerance=tolerance,
+                                  loss_tolerance=loss_tolerance)
+            rounds.append({"round": name, **{
+                k: verdict[k] for k in ("compared", "regressions",
+                                        "improvements", "new_metrics")
+            }})
+            compared += verdict["compared"]
+            regressions += len(verdict["regressions"])
+        flats.append((name, flat))
+    return {"rounds": rounds, "compared": compared,
+            "regressions": regressions, "skipped": skipped,
+            "usable_rounds": len(flats)}
+
+
+def _parse_overrides(pairs) -> dict:
+    overrides = {}
+    for pair in pairs or ():
+        key, sep, frac = pair.partition("=")
+        if not sep:
+            raise ValueError(f"--tolerance-for needs KEY=FRACTION, "
+                             f"got {pair!r}")
+        overrides[key] = float(frac)
+    return overrides
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[1],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    parser.add_argument("fresh", nargs="?",
+                        help="fresh bench evidence: bench.py stdout "
+                             "(text), a BENCH_r*.json wrapper, or '-' "
+                             "for stdin")
+    parser.add_argument("--trajectory", default="",
+                        help="baseline glob (default: repo BENCH_r*.json)")
+    parser.add_argument("--tolerance", type=float,
+                        default=DEFAULT_TOLERANCE,
+                        help="allowed relative DROP for throughput-like "
+                             "metrics")
+    parser.add_argument("--loss-tolerance", type=float,
+                        default=DEFAULT_LOSS_TOLERANCE,
+                        help="allowed relative RISE for final_loss")
+    parser.add_argument("--tolerance-for", action="append", metavar="K=F",
+                        help="per-metric override, e.g. "
+                             "moco_v2_r50_pretrain_throughput_per_chip=0.1")
+    parser.add_argument("--allow-failed", action="store_true",
+                        help="do not fail the gate when the fresh bench "
+                             "itself produced no metrics")
+    parser.add_argument("--self-test", action="store_true",
+                        help="replay the committed trajectory; exit 1 on "
+                             "any false regression")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the verdict as one JSON object")
+    args = parser.parse_args(argv)
+    try:
+        overrides = _parse_overrides(args.tolerance_for)
+    except ValueError as e:
+        print(f"usage error: {e}", file=sys.stderr)
+        return 2
+
+    if args.self_test:
+        try:
+            verdict = self_test(args.trajectory or None,
+                                tolerance=args.tolerance,
+                                loss_tolerance=args.loss_tolerance)
+        except (FileNotFoundError, OSError) as e:
+            print(f"usage error: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(verdict))
+        else:
+            print(f"bench_gate self-test: {verdict['usable_rounds']} "
+                  f"usable round(s), {verdict['compared']} comparison(s), "
+                  f"{verdict['regressions']} regression(s), skipped "
+                  f"{verdict['skipped']}")
+        return 1 if verdict["regressions"] else 0
+
+    if not args.fresh:
+        parser.print_usage(sys.stderr)
+        print("usage error: need a fresh bench record (or --self-test)",
+              file=sys.stderr)
+        return 2
+    if args.fresh == "-":
+        source: object = sys.stdin.read()
+    else:
+        try:
+            with open(args.fresh, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            print(f"usage error: cannot read {args.fresh}: {e}",
+                  file=sys.stderr)
+            return 2
+        try:
+            source = json.loads(text)
+        except json.JSONDecodeError:
+            source = text  # raw bench stdout
+    fresh_flat, details = flatten(source)
+    if not fresh_flat:
+        msg = "fresh bench produced no metric-bearing records"
+        if args.allow_failed:
+            print(f"bench_gate: PASS (degraded: {msg})")
+            return 0
+        print(f"bench_gate: FAIL — {msg}", file=sys.stderr)
+        return 1
+    verdict = gate_record(fresh_flat,
+                          load_trajectory_flats(args.trajectory or None),
+                          tolerance=args.tolerance,
+                          loss_tolerance=args.loss_tolerance,
+                          overrides=overrides)
+    verdict["detail_rows_ignored"] = details
+    if args.json:
+        print(json.dumps(verdict))
+    else:
+        for r in verdict["regressions"]:
+            print(f"REGRESSION {r['metric']}: {r['value']} vs "
+                  f"{r['baseline']} ({r['baseline_round']}) — "
+                  f"×{r['ratio']} beyond tolerance {r['tolerance']}")
+        for r in verdict["improvements"]:
+            print(f"improved   {r['metric']}: {r['value']} vs "
+                  f"{r['baseline']} ({r['baseline_round']}) ×{r['ratio']}")
+        for r in verdict["passes"]:
+            print(f"ok         {r['metric']}: {r['value']} vs "
+                  f"{r['baseline']} ({r['baseline_round']}) ×{r['ratio']}")
+        for name in verdict["new_metrics"]:
+            print(f"new        {name}: no baseline in the trajectory")
+        state = "FAIL" if verdict["regressions"] else "PASS"
+        print(f"bench_gate: {state} ({verdict['compared']} compared, "
+              f"{len(verdict['new_metrics'])} new, {details} detail "
+              f"row(s) not gated)")
+    return 1 if verdict["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

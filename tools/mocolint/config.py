@@ -227,6 +227,22 @@ DEFAULT_CONFIG = LintConfig(
                  "runtime cannot take the routing tier down with the "
                  "replicas"),
         ),
+        # ISSUE 12: obsd watches the fleet from outside — it must keep
+        # answering /metrics while the runtimes it observes OOM or
+        # crash-loop, so it obeys the same import diet as the supervisor
+        Boundary(
+            name="obsd-stdlib-only",
+            rule_id="R11",
+            scope=("moco_tpu/telemetry/aggregate.py", "tools/obsd.py"),
+            stdlib_only=True,
+            allow_prefixes=("moco_tpu",),
+            transitive=True,
+            why=("the metrics aggregator + SLO engine is the layer an "
+                 "operator trusts DURING an incident: importing jax/numpy "
+                 "(directly or through a moco_tpu module) would couple "
+                 "its liveness to the exact runtimes whose failures it "
+                 "exists to report"),
+        ),
         Boundary(
             name="supervisor-stdlib-only",
             rule_id="R11",

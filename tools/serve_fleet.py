@@ -96,6 +96,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "deadline_ms wins)")
     p.add_argument("--watch-poll-secs", type=float, default=1.0)
     p.add_argument("--reload-timeout-s", type=float, default=300.0)
+    p.add_argument("--stats-every-secs", type=float, default=30.0,
+                   help="router_stats emit cadence — the autoscaler/obsd "
+                        "input stream (cumulative per-code sheds, "
+                        "outstanding depth, latency p50/p95/p99)")
     p.add_argument("--chaos", default="",
                    help="drill fault spec for ONE replica, e.g. "
                         "kill_at_request=200 (see resilience/chaos.py)")
@@ -153,6 +157,7 @@ def main(argv=None) -> int:
         request_timeout_s=args.request_timeout_s,
         watch_poll_secs=args.watch_poll_secs,
         reload_timeout_s=args.reload_timeout_s,
+        stats_every_secs=args.stats_every_secs,
     )
     fleet = FleetSupervisor(
         child_argv,

@@ -39,6 +39,10 @@ Renders, from the schema-versioned record stream the driver writes
     (detected / rolled / quarantined), and a per-replica fold of each
     replica's own last serve snapshot (the single-file `serve:` section
     assumes exactly one server)
+  - SLO transitions (ISSUE 12): the `kind: "slo"` alert/recovery records
+    tools/obsd.py appends into the same stream, folded per rule
+    (alert/recovery counts, still-active rules) as a `slo:` section —
+    and rendered live by --follow, like fleet/resize lines
   - pod-record count and worst cross-host step-time spread
 
 `--follow` (ISSUE 8 satellite) is the live-tail mode: poll the file and
@@ -137,6 +141,7 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
     supervisor = [r for r in records if r.get("kind") == "supervisor"]
     serves = [r for r in records if r.get("kind") == "serve"]
     fleet = [r for r in records if r.get("kind") == "fleet"]
+    slos = [r for r in records if r.get("kind") == "slo"]
 
     step_s = [r["step_s"] for r in steps if "step_s" in r]
     data_s = [r["data_s"] for r in steps if "data_s" in r]
@@ -296,9 +301,41 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
         summary["serve"]["snapshots"] = len(serves)
     if fleet:
         summary["fleet"] = _summarize_fleet(fleet, serves)
+    if slos:
+        summary["slo"] = _summarize_slo(slos)
     if run_ends:
         summary["run_end"] = run_ends[-1]
     return summary
+
+
+def _summarize_slo(slos: list[dict]) -> dict:
+    """Fold the `kind:"slo"` records obsd (ISSUE 12) appended into the
+    stream: per-rule alert/recovery counts + whether the LAST transition
+    left the rule alerting (the stream is ordered, so last wins)."""
+    by_rule: dict[str, dict] = {}
+    for r in slos:
+        rule = str(r.get("rule", "?"))
+        entry = by_rule.setdefault(rule, {
+            "alerts": 0, "recoveries": 0, "active": False,
+        })
+        action = r.get("action")
+        if action == "alert":
+            entry["alerts"] += 1
+            entry["active"] = True
+        elif action == "recover":
+            entry["recoveries"] += 1
+            entry["active"] = False
+        for k in ("objective", "threshold", "severity"):
+            if k in r:
+                entry[k] = r[k]
+        if "value_fast" in r:
+            entry["last_value"] = r["value_fast"]
+    return {
+        "alerts": sum(e["alerts"] for e in by_rule.values()),
+        "recoveries": sum(e["recoveries"] for e in by_rule.values()),
+        "active": sorted(r for r, e in by_rule.items() if e["active"]),
+        "by_rule": by_rule,
+    }
 
 
 def _summarize_resize(supervisor: list[dict]) -> dict | None:
@@ -368,13 +405,17 @@ def _summarize_fleet(fleet: list[dict], serves: list[dict]) -> dict:
             k: last[k]
             for k in ("requests", "ok", "retries", "retry_ok",
                       "shed_no_backend", "upstream_timeout",
-                      "upstream_error", "passthrough_non_200", "healthy")
+                      "upstream_error", "shed_deadline_router",
+                      "passthrough_non_200", "healthy",
+                      # ISSUE 12 autoscaler-schema fields
+                      "outstanding", "latency_ms", "window", "interval_s")
             if k in last
         }
         reqs = router.get("requests", 0)
         shed = (router.get("shed_no_backend", 0)
                 + router.get("upstream_timeout", 0)
-                + router.get("upstream_error", 0))
+                + router.get("upstream_error", 0)
+                + router.get("shed_deadline_router", 0))
         router["shed_rate"] = round(shed / reqs, 4) if reqs else 0.0
         sec["router"] = router
     reload_events = ("reload_detected", "reload_replica", "reload_done",
@@ -638,6 +679,15 @@ def render(summary: dict) -> str:
             f"({router.get('retries', 0)} retried, shed rate "
             f"{100 * router.get('shed_rate', 0):.2f}%)"
         )
+        lat = router.get("latency_ms")
+        if lat:
+            lines.append(
+                f"  router latency (window {router.get('window', '?')}): "
+                f"p50 {lat.get('p50', 0):.1f} ms · "
+                f"p95 {lat.get('p95', 0):.1f} ms · "
+                f"p99 {lat.get('p99', 0):.1f} ms · outstanding "
+                f"{router.get('outstanding', 0)}"
+            )
         for idx, rep in sorted(flt.get("replicas", {}).items()):
             counts: dict[str, int] = {}
             for c in rep["classifications"]:
@@ -670,6 +720,25 @@ def render(summary: dict) -> str:
                 + (f" · {len(quarantined)} quarantined "
                    f"({', '.join(str(h.get('step')) for h in quarantined[-6:])})"
                    if quarantined else "")
+            )
+    slo = summary.get("slo")
+    if slo:
+        active = slo.get("active", [])
+        lines.append(
+            f"slo: {slo.get('alerts', 0)} alert(s), "
+            f"{slo.get('recoveries', 0)} recovery(ies)"
+            + (f" — ACTIVE: {', '.join(active)}" if active
+               else " — all clear")
+        )
+        for rule, e in sorted(slo.get("by_rule", {}).items()):
+            detail = (f"{e.get('objective', '?')} vs "
+                      f"{e.get('threshold', '?')}")
+            if "last_value" in e:
+                detail += f", last {e['last_value']}"
+            lines.append(
+                f"  {rule}: {e['alerts']} alert(s) / "
+                f"{e['recoveries']} recovery(ies) ({detail})"
+                + (" [ACTIVE]" if e.get("active") else "")
             )
     progs = summary.get("programs")
     if progs:
@@ -758,6 +827,20 @@ def render_record(rec: dict) -> str | None:
             if k not in ("v", "t", "kind", "event", "run_id", "trace_id")
         )
         return f"fleet: {rec.get('event', '?')} {detail}".rstrip()
+    if kind == "slo":
+        # obsd transitions (ISSUE 12): an alert in progress must jump out
+        # of the step stream the way resize/fleet lines do
+        action = str(rec.get("action", "?")).upper()
+        parts = [f"slo: {action} {rec.get('rule', '?')}"]
+        if "value_fast" in rec:
+            parts.append(
+                f"{rec.get('objective', '?')}={rec['value_fast']} "
+                f"(slow {rec.get('value_slow', '?')}) "
+                f"{rec.get('op', '>')} {rec.get('threshold', '?')}"
+            )
+        if "run_id" in rec:
+            parts.append(f"run={rec['run_id']}")
+        return " ".join(parts)
     if kind == "serve":
         lat = rec.get("latency_ms") or {}
         return (
