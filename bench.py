@@ -785,6 +785,55 @@ def _telemetry_overhead_row(step_p50_ms: float, steps: int = 2000) -> dict:
     }
 
 
+def _health_overhead_row(config, mesh, step_p50_ms: float) -> dict:
+    """In-graph learning-health diagnostics cost (ISSUE 13 acceptance:
+    amortized overhead < 1% of p50 step time at the default stride).
+    Builds the SAME fused program with `health_stride=DEFAULT_STRIDE`
+    and times one synced stride-covering window, splitting ON-stride
+    samples (the cond's real diagnostics branch) from OFF-stride ones
+    (the zero branch): the amortized per-step cost is the on-stride
+    premium divided by the stride, expressed against the headline
+    (diagnostics-off) p50 — the same "share of step time" basis as the
+    telemetry_overhead row. The off-stride p50 doubles as evidence that
+    the gated program's steady state matches the headline program."""
+    from moco_tpu.telemetry import percentiles_ms
+    from moco_tpu.telemetry.health import DEFAULT_STRIDE
+    from moco_tpu.utils.benchkit import build_v2_fused_bench
+
+    stride = DEFAULT_STRIDE
+    try:
+        cfg = config.replace(health_stride=stride)
+        fused, state, imgs_u8, extents = build_v2_fused_bench(cfg, mesh)
+        m = None
+        for w in range(2):  # compile + first-donation round; state.step
+            state, m = fused(state, imgs_u8, extents, w)  # is now 2
+        assert np.isfinite(float(m["loss"])), "non-finite health-bench loss"
+        times_on, times_off = [], []
+        for i in range(3 * stride):
+            t0 = time.perf_counter()
+            state, metrics = fused(state, imgs_u8, extents, 2 + i)
+            loss = float(metrics["loss"])  # the only reliable sync (relay)
+            # the cond keys on state.step, which the warmup left at 2 + i
+            (times_on if (2 + i) % stride == 0
+             else times_off).append(time.perf_counter() - t0)
+        assert np.isfinite(loss), f"non-finite health-bench loss {loss}"
+        on_ms = percentiles_ms(times_on)["p50"]
+        off_ms = percentiles_ms(times_off)["p50"]
+        premium_ms = max(on_ms - off_ms, 0.0)
+        amortized_ms = premium_ms / stride
+        return {
+            "stride": stride,
+            "step_ms_on_stride_p50": round(on_ms, 3),
+            "step_ms_off_stride_p50": round(off_ms, 3),
+            "overhead_ms_per_step": round(amortized_ms, 6),
+            "overhead_pct_of_step_p50": round(
+                100.0 * amortized_ms / step_p50_ms, 4)
+            if step_p50_ms else 0.0,
+        }
+    except Exception as e:  # noqa: BLE001 — degraded row, never fatal
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def main():
     import jax
 
@@ -844,6 +893,10 @@ def main():
     # span-layer overhead row (ISSUE 8 acceptance: trace_mode=steps must
     # cost well under 3% of step time vs off)
     telemetry_detail = _telemetry_overhead_row(step_pcts["p50"])
+    # in-graph learning-health diagnostics row (ISSUE 13 acceptance:
+    # amortized cost < 1% of step p50 at the default stride; bench_gate
+    # enforces the absolute cap)
+    health_detail = _health_overhead_row(config, mesh, step_pcts["p50"])
     print(
         json.dumps(
             {
@@ -858,6 +911,7 @@ def main():
                 "step_time_synced_ms": step_pcts,
                 "grad_sync": grad_sync_detail,
                 "telemetry_overhead": telemetry_detail,
+                "health_overhead": health_detail,
                 # measured cold/warm compile evidence (VERDICT r4 #2): on
                 # the first healthy contact this records how much of the
                 # window the compile ate; with the persistent cache warm it

@@ -191,6 +191,44 @@ class PretrainConfig:
     trace_device_profile: bool = False  # capture windows also record a
                                       # jax.profiler device trace into
                                       # <telemetry_dir>/traces/
+    # learning-health diagnostics (telemetry/health.py; ISSUE 13 — see
+    # README "Learning health" for formulas and sentinel semantics)
+    health_stride: int = 0            # 0 = off (no diagnostics traced;
+                                      # the health-on parameter trajectory
+                                      # is bitwise the health-off one);
+                                      # N = trace the in-graph
+                                      # collapse diagnostics (embedding
+                                      # std/participation ratio, queue
+                                      # norm/age, q↔k param drift, grad
+                                      # group norms) under one lax.cond
+                                      # firing every N steps, recorded as
+                                      # the step records' `health` block.
+                                      # neg_sim/logit_margin are standard
+                                      # metrics regardless of this knob.
+    collapse_window: int = 50         # CollapseSentinel window W, in
+                                      # OBSERVATIONS (per-step for
+                                      # margin/acc1, per-health-stride
+                                      # sample for embedding std)
+    collapse_min_step: int = 0        # sentinel predicates evaluate only
+                                      # past this step (init-time acc1 IS
+                                      # chance and the margin is still
+                                      # forming — an early window must
+                                      # not page anyone)
+    collapse_acc1: float = 0.0        # predicate: max acc1 over a full
+                                      # window < this floor (%; 0 = off)
+    collapse_emb_std: float = 0.0     # predicate: every sampled
+                                      # embedding std in a full window
+                                      # <= this epsilon (0 = off; needs
+                                      # health_stride > 0 to see samples)
+    collapse_margin: float = 0.0      # predicate: max logit margin over
+                                      # a full window <= this (0 = off)
+    collapse_rollback: bool = False   # opt-in: a fired predicate raises
+                                      # CollapseError into the bounded
+                                      # NaN-rollback path (restore last
+                                      # good checkpoint + data-window
+                                      # advance, max_rollbacks-capped);
+                                      # default is a structured `health`
+                                      # incident only
     ckpt_dir: str = "checkpoints"
     ckpt_every_epochs: int = 1
     resume: str = ""                  # path | "auto"
@@ -301,6 +339,32 @@ class PretrainConfig:
         if self.trace_slow_step_k <= 1.0:
             raise ValueError(
                 f"trace_slow_step_k must be > 1, got {self.trace_slow_step_k}"
+            )
+        # learning-health knobs (ISSUE 13): config stays importable
+        # without jax — literals only, like the gradsync/trace blocks
+        if self.health_stride < 0:
+            raise ValueError(
+                f"health_stride must be >= 0, got {self.health_stride}"
+            )
+        if self.collapse_window < 1:
+            raise ValueError(
+                f"collapse_window must be >= 1, got {self.collapse_window}"
+            )
+        if self.collapse_min_step < 0:
+            raise ValueError(
+                f"collapse_min_step must be >= 0, got {self.collapse_min_step}"
+            )
+        for knob in ("collapse_acc1", "collapse_emb_std", "collapse_margin"):
+            if getattr(self, knob) < 0:
+                raise ValueError(
+                    f"{knob} must be >= 0 (0 disables the predicate), "
+                    f"got {getattr(self, knob)}"
+                )
+        if self.collapse_emb_std and not self.health_stride:
+            raise ValueError(
+                "collapse_emb_std needs health_stride > 0: the embedding-"
+                "std predicate consumes the stride-sampled in-graph "
+                "diagnostics and would otherwise watch an empty stream"
             )
 
     def replace(self, **kw) -> "PretrainConfig":
@@ -421,6 +485,15 @@ class ServeConfig:
     knn_temperature: float = 0.07
     num_classes: int = 0              # 0 = derive from bank labels
     drain_timeout_s: float = 60.0     # SIGTERM: max wait for in-flight work
+    # hot-reload drift guard (ISSUE 13): before swapping a reloaded
+    # engine in, embed a fixed probe batch on old+new and refuse (409
+    # reload_collapsed — the fleet quarantines the step) a checkpoint
+    # whose probe embeddings are degenerate
+    reload_probe: int = 8             # probe rows (0 = guard off)
+    reload_min_spread: float = 1e-4   # refuse when 1-‖mean unit row‖ of
+                                      # the NEW engine's probe embeddings
+                                      # falls below this (rank-one
+                                      # collapse as seen from serving)
 
     def __post_init__(self):
         # the ONE bucket-ladder rule, shared with the runtime's own check
@@ -440,6 +513,12 @@ class ServeConfig:
         if self.embed_cache_mb < 0:
             raise ValueError(
                 f"embed_cache_mb must be >= 0, got {self.embed_cache_mb}"
+            )
+        if self.reload_probe < 0 or self.reload_min_spread < 0:
+            raise ValueError(
+                "reload_probe and reload_min_spread must be >= 0 "
+                f"(0 disables the guard), got {self.reload_probe} / "
+                f"{self.reload_min_spread}"
             )
         if self.trace_mode not in ("off", "steps", "full"):
             raise ValueError(

@@ -49,6 +49,7 @@ from moco_tpu.resilience.chaos import (
     truncate_checkpoint,
 )
 from moco_tpu.resilience.errors import (
+    CollapseError,
     DataQualityError,
     NonFiniteLossError,
     RollbackExhaustedError,
@@ -78,7 +79,7 @@ from moco_tpu.resilience.resize import (
     read_recorded_devices,
     write_resize_request,
 )
-from moco_tpu.resilience.sentinel import NaNSentinel
+from moco_tpu.resilience.sentinel import CollapseSentinel, NaNSentinel
 from moco_tpu.resilience.supervisor import (
     RestartPolicy,
     Supervisor,
@@ -90,6 +91,8 @@ from moco_tpu.resilience.watchdog import StepWatchdog
 
 __all__ = [
     "ChaosPlan",
+    "CollapseError",
+    "CollapseSentinel",
     "DataQualityError",
     "EXIT_CODE_NAMES",
     "EXIT_CONFIG_ERROR",

@@ -107,6 +107,24 @@ class ChaosPlan:
     resize_devices: int = 0                 # target device count for the
                                             # drill (spec alias: `devices=M`;
                                             # 0 = "whatever is visible")
+    collapse_at_step: int | None = None     # learning-health drill (ISSUE
+                                            # 13): from step k onward the
+                                            # driver rewrites the key-
+                                            # encoder params with
+                                            # health.crush_key_params so
+                                            # its features degenerate to
+                                            # one constant vector — the
+                                            # injected representation
+                                            # collapse the in-graph
+                                            # diagnostics, the
+                                            # CollapseSentinel, the obsd
+                                            # learning-health SLOs and the
+                                            # serve reload drift guard are
+                                            # all drilled against. A
+                                            # PERSISTENT fault (re-applied
+                                            # every step: the EMA would
+                                            # otherwise heal it within one
+                                            # step), logged once.
     wedge_at_request: int | None = None     # serve-side: after the k-th
                                             # admitted request, STOP answering
                                             # (every later HTTP request —
@@ -228,6 +246,25 @@ class ChaosPlan:
             return self.resize_devices
         return None
 
+    def maybe_collapse(self, step: int) -> bool:
+        """True for EVERY step at/after `collapse_at_step`: the caller
+        (the driver) rewrites the key-encoder params with the degenerate
+        `health.crush_key_params` tree after each such step. Persistent
+        by design — the in-step EMA leaks (1−m)·θ_q back before every key
+        forward, so a one-shot crush would heal itself within one step;
+        the fault models a momentum update that is wedged, not glitched.
+        The onset is logged once (plain fire-once: the drill is not a
+        process-killing fault, so no cross-restart marker is needed)."""
+        if self.collapse_at_step is None or step < self.collapse_at_step:
+            return False
+        if self._fire_once("collapse"):
+            log_event(
+                "chaos",
+                f"injecting representation collapse from step {step}: "
+                "key-encoder params crushed to a constant-feature tree",
+            )
+        return True
+
     def maybe_nan(self, step: int) -> bool:
         """True at the configured step (the first `nan_count` traversals of
         it): the caller replaces the step's reported loss with NaN — the
@@ -275,6 +312,7 @@ _INT_FIELDS = (
     "loader_error_count",
     "kill_at_request",
     "wedge_at_request",
+    "collapse_at_step",
     "resize_at_step",
     "resize_devices",
 )

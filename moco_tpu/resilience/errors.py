@@ -30,6 +30,27 @@ class NonFiniteLossError(FloatingPointError):
         self.pos = pos
 
 
+class CollapseError(NonFiniteLossError):
+    """A CollapseSentinel predicate fired with rollback opted in
+    (`collapse_rollback=True`). Subclasses NonFiniteLossError so the
+    driver's existing bounded-rollback machinery (restore the last good
+    checkpoint, advance the data window, `max_rollbacks` cap) handles a
+    detected representation collapse exactly like a non-finite loss —
+    the recovery policy IS the type, and it is the same policy."""
+
+    def __init__(self, step: int, predicate: str, value: float,
+                 pos: tuple[int, int] | None = None):
+        FloatingPointError.__init__(
+            self,
+            f"collapse predicate {predicate!r} fired at step {step} "
+            f"(value {value!r}); requesting rollback",
+        )
+        self.step = int(step)
+        self.predicate = predicate
+        self.value = value
+        self.pos = pos
+
+
 class RollbackExhaustedError(RuntimeError):
     """More than `max_rollbacks` consecutive NaN rollbacks — the divergence
     is not a poisoned data window, something is structurally wrong (lr blowup,

@@ -51,7 +51,9 @@ _EXPORTS = {
     "EmbeddingEngine": "engine",
     "ServeFrontend": "http",
     "decode_image": "http",
+    "CollapsedCheckpointError": "service",
     "EmbedService": "service",
+    "ReloadRefusedError": "service",
     "CheckpointWatcher": "fleet",
     "FleetPolicy": "fleet",
     "FleetRouter": "fleet",

@@ -373,6 +373,19 @@ class CheckpointWatcher:
         chosen = known[0] if known else (files[0] if len(files) == 1 else None)
         return os.path.join(step_dir, chosen) if chosen else None
 
+    def quarantine(self, step: int, reason: str) -> None:
+        """Quarantine a step AFTER discovery (the reload drift guard's
+        path, ISSUE 13: the manifest verified — the bytes are intact —
+        but the WEIGHTS are degenerate). Filesystem errors are emitted,
+        not raised: the caller is the fleet's reload roll, which must
+        keep rolling whatever the watch dir allows."""
+        try:
+            self._quarantine(step, reason)
+        except OSError as e:
+            self._emit("reload_watch_error",
+                       detail=f"quarantine step {step}: "
+                              f"{type(e).__name__}: {e}")
+
     def _quarantine(self, step: int, reason: str) -> None:
         qdir = os.path.join(self.watch_dir, QUARANTINE_DIRNAME)
         os.makedirs(qdir, exist_ok=True)
@@ -479,6 +492,9 @@ class FleetSupervisor:
         self._target_step = -1
         self._target_path: str | None = None
         self._announced_step = -1
+        self._good_pretrained: str | None = None  # last payload every
+                                       # replica deployed (quarantine
+                                       # rollback target, ISSUE 13)
         # the roll runs from the watcher thread (new step) AND the
         # monitor thread (a recovered replica converging): serialize so
         # one replica never sees two concurrent /admin/reload POSTs
@@ -1122,7 +1138,13 @@ class FleetSupervisor:
         with self._lock:
             self._target_step = step
             self._target_path = path
-            self._current_pretrained = path
+            # deliberately NOT _current_pretrained yet: the relaunch argv
+            # pins a payload with no boot-time drift guard, so it only
+            # ever carries VERIFIED weights (first successful guarded
+            # deploy below) — a replica dying during the minutes-long
+            # first reload attempt must not boot straight onto a
+            # checkpoint no guard has judged; it boots on the last good
+            # payload and converges via /admin/reload once healthy
         self._emit("reload_detected", step=step, path=path)
         self._reload_sync()
 
@@ -1157,6 +1179,14 @@ class FleetSupervisor:
             if ok:
                 with self._lock:
                     r.deployed_step = step
+                    # known-good from the FIRST successful deploy (the
+                    # replica's drift guard passed it), not only from a
+                    # completed roll: with one replica down, a later
+                    # quarantine must still roll the relaunch argv back
+                    # to this payload, never past it to the boot weights
+                    # — and only NOW may the relaunch argv pin it
+                    self._good_pretrained = path
+                    self._current_pretrained = path
                 self._emit("reload_replica", replica=r.index, step=step,
                            status="ok", detail=detail)
             else:
@@ -1173,6 +1203,14 @@ class FleetSupervisor:
                 if announce:
                     self._emit("reload_failed", replica=r.index,
                                step=step, detail=detail)
+                if "reload_collapsed" in detail:
+                    # drift guard (ISSUE 13): the replica judged the
+                    # CHECKPOINT collapsed (degenerate probe embeddings),
+                    # not its own config — quarantine the step dir so no
+                    # other replica, relaunch argv, or later fleet ever
+                    # promotes it, and stop targeting it
+                    self._quarantine_collapsed(step, detail)
+                    return
         with self._lock:
             done = all(
                 r.deployed_step >= step
@@ -1183,6 +1221,30 @@ class FleetSupervisor:
         if done:
             self._emit("reload_done", step=step, path=path,
                        replicas=self.n_replicas)
+
+    def _quarantine_collapsed(self, step: int, detail: str) -> None:
+        """A replica's reload drift guard judged step's checkpoint
+        COLLAPSED (degenerate probe embeddings — ISSUE 13). The refusal
+        is deterministic (same probe batch, same weights), so one
+        replica's verdict stands for the fleet: quarantine the step dir
+        (never re-discovered, never promoted by a later fleet), drop it
+        as the reload target, and roll the relaunch argv back to the
+        last known-good payload so a replica dying NOW does not boot on
+        the refused weights."""
+        with self._lock:
+            if self._target_step == step:
+                self._target_path = None
+                self._current_pretrained = self._good_pretrained
+        if self._watcher is not None:
+            self._watcher.quarantine(
+                step, f"reload drift guard: {detail[:160]}"
+            )
+        log_event(
+            "fleet",
+            f"checkpoint step {step} refused by the reload drift guard "
+            f"(collapsed probe embeddings); quarantined — the fleet "
+            f"keeps serving the previous weights",
+        )
 
     def _post_reload(self, r: ReplicaState, step: int,
                      path: str) -> tuple[bool, str]:

@@ -44,7 +44,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from moco_tpu.serve.batcher import RejectionError
-from moco_tpu.serve.service import ReloadRefusedError
+from moco_tpu.serve.service import (
+    CollapsedCheckpointError,
+    ReloadRefusedError,
+)
 
 
 def decode_image(req: dict) -> np.ndarray:
@@ -190,6 +193,12 @@ def _make_handler(service):
             try:
                 entry = service.reload(str(req["pretrained"]), step)
                 self._send(200, {"status": "reloaded", **entry})
+            except CollapsedCheckpointError as e:
+                # drift guard (ISSUE 13): the CHECKPOINT is bad, not this
+                # process's config — its own error code so the fleet
+                # quarantines the step instead of merely not retrying
+                self._send(409, {"error": "reload_collapsed",
+                                 "detail": str(e)})
             except ReloadRefusedError as e:
                 # TERMINAL for this process config (kNN bank, image_size,
                 # ladder): 409 — the fleet stops retrying this step here

@@ -59,6 +59,15 @@ class ReloadRefusedError(ValueError):
     (http.py answers 409 for refusals, 503 for retryable failures)."""
 
 
+class CollapsedCheckpointError(ReloadRefusedError):
+    """The reload drift guard (ISSUE 13) rejected the NEW engine: its
+    embeddings of the fixed probe batch are degenerate (every probe maps
+    to ~one direction — the serving face of representation collapse) or
+    unrelated to the previous engine's. Terminal like every refusal, but
+    the CHECKPOINT is at fault, not this process's config — the fleet
+    quarantines the step dir so no replica (or later fleet) promotes it."""
+
+
 class EmbedService:
     def __init__(
         self,
@@ -77,6 +86,8 @@ class EmbedService:
         num_classes: int = 0,
         knn_k: int = 200,
         knn_temperature: float = 0.07,
+        reload_probe: int = 8,
+        reload_min_spread: float = 1e-4,
     ):
         self.engine = engine
         self.feat_dim = engine.warmup()  # every bucket compiled before traffic
@@ -94,6 +105,11 @@ class EmbedService:
         self._engine_factory = None
         self._reload_lock = threading.Lock()
         self.reloads = 0
+        # reload drift guard (ISSUE 13): rows in the fixed probe batch
+        # (0 disables the guard) + the spread floor under which a new
+        # engine's probe embeddings count as collapsed
+        self.reload_probe = int(reload_probe)
+        self.reload_min_spread = float(reload_min_spread)
         self._reload_history: list[dict] = []
         self._engine_gen = 0  # bumped at every swap: an in-flight request
                               # that executed on the OLD engine must not
@@ -305,6 +321,23 @@ class EmbedService:
                 feat_dim = new_engine.warmup()  # whole ladder, off-path
             except (ValueError, OSError, KeyError) as e:
                 raise ValueError(f"cannot load {pretrained!r}: {e}") from e
+            # reload drift guard (ISSUE 13): embed one fixed probe batch
+            # on BOTH engines (off-path — the live engine keeps serving)
+            # and refuse a checkpoint whose probe embeddings collapsed.
+            # A full lincls run is the honest quality gate; this is the
+            # cheap one that catches the silent failure mode training's
+            # CollapseSentinel watches for, at the promotion boundary.
+            probe = self._probe_stats(new_engine)
+            if probe is not None and probe["probe_spread"] < \
+                    self.reload_min_spread:
+                raise CollapsedCheckpointError(
+                    f"reload refused: probe-batch embeddings of "
+                    f"{pretrained!r} are degenerate (spread "
+                    f"{probe['probe_spread']:.2e} < "
+                    f"{self.reload_min_spread:.2e}; drift vs live engine "
+                    f"{probe['probe_drift']:.4f}) — the checkpoint looks "
+                    "collapsed; keeping the previous weights"
+                )
             warm_s = time.monotonic() - t0
             # THE swap: one reference assignment; the next micro-batch the
             # flusher executes reads the new engine
@@ -324,6 +357,8 @@ class EmbedService:
                 "warm_s": round(warm_s, 3),
                 "feat_dim": feat_dim,
             }
+            if probe is not None:
+                entry.update(probe)
             with self._lock:
                 self.reloads += 1
                 self._reload_history.append(entry)
@@ -336,6 +371,47 @@ class EmbedService:
             if self.registry is not None:
                 self.registry.emit("event", event="serve_reload", **entry)
             return entry
+
+    def _probe_stats(self, new_engine) -> dict | None:
+        """Cosine drift + dispersion of a fixed probe batch, new engine
+        vs live (ISSUE 13). Returns None when the guard is disabled
+        (`reload_probe=0`) or either dimensionality makes the comparison
+        meaningless (feat-dim change: drift is undefined, and a dim
+        change already implies a deliberate re-deploy).
+
+          probe_drift   1 − mean row-wise cosine(old, new): how far the
+                        embedding space moved — recorded for the
+                        operator (training between exports MOVES it;
+                        drift alone is not a failure)
+          probe_spread  1 − ‖mean(new unit rows)‖: 0 when every probe
+                        maps to one direction — rank-one collapse as
+                        seen from serving. THE quarantine signal.
+        """
+        if self.reload_probe <= 0:
+            return None
+        s = new_engine.image_size
+        n = min(self.reload_probe, new_engine.buckets[-1])
+        if n < 2:
+            return None  # one row has spread 0 by construction
+        # deterministic probe (seeded ctor: mocolint R9-clean): the same
+        # batch across reloads makes drift numbers comparable run-long
+        probe = np.random.default_rng(20130613).integers(
+            0, 256, size=(n, s, s, 3), dtype=np.uint8
+        )
+        old = self.engine.embed(probe)
+        new = new_engine.embed(probe)
+        if old.shape != new.shape:
+            return None
+
+        def unit(rows: np.ndarray) -> np.ndarray:
+            norms = np.linalg.norm(rows, axis=-1, keepdims=True)
+            return rows / np.maximum(norms, 1e-12)
+
+        u_old, u_new = unit(old), unit(new)
+        drift = 1.0 - float(np.mean(np.sum(u_old * u_new, axis=-1)))
+        spread = 1.0 - float(np.linalg.norm(np.mean(u_new, axis=0)))
+        return {"probe_drift": round(drift, 6),
+                "probe_spread": round(spread, 6)}
 
     # -- telemetry -----------------------------------------------------------
     def _note_batch(self, n: int, bucket: int, wait_s: float) -> None:

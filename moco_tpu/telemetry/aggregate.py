@@ -227,9 +227,11 @@ class RunWindow:
         self._events: deque = deque(maxlen=ring)      # (mono, name)
         self._router: deque = deque(maxlen=256)       # (mono, record)
         self._serve: deque = deque(maxlen=256)        # (mono, record)
+        self._health: deque = deque(maxlen=256)       # (mono, block, step)
         self.last_step: dict | None = None
         self.last_router: dict | None = None
         self.last_serve: dict | None = None
+        self.last_health: dict | None = None
 
     # -- ingest --------------------------------------------------------------
     def ingest(self, rec: dict, src: str, path: str, now: float,
@@ -260,11 +262,14 @@ class RunWindow:
         if kind == "step":
             self.steps_total += 1
             self.last_step = rec
+            try:
+                step_no = int(rec.get("step", self.steps_total))
+            except (TypeError, ValueError):
+                step_no = self.steps_total
+            health = rec.get("health")
+            if isinstance(health, dict):
+                self.last_health = health
             if not historical:
-                try:
-                    step_no = int(rec.get("step", self.steps_total))
-                except (TypeError, ValueError):
-                    step_no = self.steps_total
                 self._steps.append((
                     now,
                     float(rec.get("step_s") or 0.0),
@@ -272,6 +277,8 @@ class RunWindow:
                     rec.get("mfu"),
                     step_no,
                 ))
+                if isinstance(health, dict):
+                    self._health.append((now, health, step_no))
         elif kind == "event":
             name = str(rec.get("event", "unknown"))
             self.incidents[name] = self.incidents.get(name, 0) + 1
@@ -351,9 +358,36 @@ class RunWindow:
           resize_relaunches             resize_relaunch records in window
           stale_s                       seconds since the newest record
           event:<name>                  count of that event name in window
+          health:<key>                  windowed MEAN of that key in the
+                                        step records' learning-health
+                                        block (ISSUE 13; keys as written
+                                        by the driver: logit_margin,
+                                        emb_std_q, emb_std_k, emb_pr_q,
+                                        qnorm_min, pdrift, ...)
+          health_min:<key> /            windowed MIN / MAX of the same —
+          health_max:<key>              collapse is a floor violation, and
+                                        a window MEAN would let healthy
+                                        history mask a fresh collapse
+          collapse_events               sentinel `health` incidents in
+                                        window (alias of event:health)
         """
         if name.startswith("event:"):
             return float(self.event_count((name[6:],), window_s, now))
+        if name == "collapse_events":
+            return float(self.event_count(("health",), window_s, now))
+        for prefix, fold in (("health:", None), ("health_min:", min),
+                             ("health_max:", max)):
+            if name.startswith(prefix):
+                key = name[len(prefix):]
+                cut = now - window_s
+                vals = [h[key] for (mono, h, step_no) in self._health
+                        if mono >= cut and step_no > min_step
+                        and isinstance(h.get(key), (int, float))]
+                if not vals:
+                    return None
+                if fold is None:
+                    return sum(vals) / len(vals)
+                return float(fold(vals))
         if name in ("step_time_ms_p50", "step_time_ms_p95",
                     "step_time_ms_p99", "step_time_ms_max"):
             steps = self._step_window(window_s, now, min_step)
@@ -448,6 +482,8 @@ class RunWindow:
             }
         if self.incidents:
             snap["events"] = dict(sorted(self.incidents.items()))
+        if self.last_health is not None:
+            snap["health"] = self.last_health
         return snap
 
 
@@ -875,6 +911,7 @@ class Aggregator:
             per_run = [(w.run_id, w) for w in self.windows.values()]
             step_pcts, data_share, mfu, steps_tot, stale = [], [], [], [], []
             incidents, router_g, router_lat, serve_lat = [], [], [], []
+            health_g: list = []
             router_counters: dict[str, list] = {}
             for run_id, w in per_run:
                 lab = {"run_id": run_id}
@@ -891,6 +928,11 @@ class Aggregator:
                 v = w.metric("mfu_mean", 300.0, now)
                 if v is not None:
                     mfu.append((lab, v))
+                if w.last_health:
+                    for key in sorted(w.last_health):
+                        v = w.metric(f"health:{key}", 300.0, now)
+                        if v is not None:
+                            health_g.append((dict(lab, key=key), v))
                 for name, count in w.incidents.items():
                     incidents.append((dict(lab, event=name), count))
                 if w.last_router is not None:
@@ -931,6 +973,9 @@ class Aggregator:
              "windowed (300s) input-stall share of step time", data_share)
         emit("moco_tpu_mfu", "gauge",
              "windowed (300s) mean model FLOPs utilization", mfu)
+        emit("moco_tpu_health", "gauge",
+             "windowed (300s) mean learning-health diagnostics by key",
+             health_g)
         emit("moco_tpu_run_stale_seconds", "gauge",
              "seconds since the run's newest record was observed", stale)
         emit("moco_tpu_events_total", "counter",

@@ -156,9 +156,16 @@ class RunTelemetry:
             self.heartbeat.beat(step, phase=phase)
 
     # -- per-step ------------------------------------------------------------
-    def on_step(self, step: int, phases: dict, throughput, loss=None) -> bool:
+    def on_step(self, step: int, phases: dict, throughput, loss=None,
+                health: dict | None = None) -> bool:
         """Emit one step record; returns True when this step flushed the
         sink (the driver aligns ScalarWriter.flush with that cadence).
+
+        `health` is the learning-health block (ISSUE 13): the driver
+        passes the host-pulled collapse diagnostics on health-stride
+        steps (None otherwise), and the record carries them under a
+        `health` sub-dict — the obsd `health:<key>` objectives and the
+        report's `health:` section read exactly that shape.
 
         Everything this method does — record building, span recording,
         capture-window ticks, detector checks — is measured and booked
@@ -211,6 +218,8 @@ class RunTelemetry:
             self._mfu_hist.observe(mfu)
         if loss is not None:
             record["loss"] = float(loss)
+        if health:
+            record["health"] = dict(health)
         stride = self.timer.stride or self.registry.flush_every
         if step % stride == 0:
             sampled = self.devices.sample()

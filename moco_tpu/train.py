@@ -41,6 +41,8 @@ from moco_tpu.data import (
 from moco_tpu.ops.knn import knn_accuracy
 from moco_tpu.parallel.mesh import create_mesh, local_batch_size
 from moco_tpu.resilience import (
+    CollapseError,
+    CollapseSentinel,
     DataQualityError,
     NaNSentinel,
     NonFiniteLossError,
@@ -255,9 +257,12 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
                         "structural, not a poisoned data window — aborting "
                         "for a human"
                     ) from e
+                reason = ("representation collapse"
+                          if isinstance(e, CollapseError)
+                          else "non-finite loss")
                 log_event(
                     "rollback",
-                    f"non-finite loss at step {e.step}: restoring the last "
+                    f"{reason} at step {e.step}: restoring the last "
                     f"good checkpoint and advancing the data stream past the "
                     f"poisoned window (rollback {rollbacks}/"
                     f"{config.max_rollbacks})",
@@ -540,6 +545,19 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
     # sentinel (one-step lag), hang watchdog, decode-failure meter, chaos
     plan = active_chaos()
     sentinel = NaNSentinel() if config.loss_sentinel else None
+    # learning-health sentinel (ISSUE 13): armed when any predicate has a
+    # nonzero threshold; consumes the popped health scalars below with
+    # the same one-step-lag device-read discipline as the NaN sentinel
+    collapse = None
+    if config.collapse_acc1 or config.collapse_emb_std or config.collapse_margin:
+        collapse = CollapseSentinel(
+            config.collapse_window,
+            acc1_floor=config.collapse_acc1,
+            emb_std_eps=config.collapse_emb_std,
+            margin_eps=config.collapse_margin,
+            min_step=config.collapse_min_step,
+            rollback=config.collapse_rollback,
+        )
     preempted = False
     resized = False
     _resilience = contextlib.ExitStack()
@@ -602,6 +620,20 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                     # scalar writer never see them
                     gs_pre = metrics.pop("gs_comm_pre", None)
                     gs_post = metrics.pop("gs_comm_post", None)
+                    # learning-health scalars (ISSUE 13): popped like the
+                    # gs probes so meters/scalar-writer never see them.
+                    # The h_* block carries cond-selected ZEROS on
+                    # off-stride steps — only on-stride values are real.
+                    neg_sim = metrics.pop("neg_sim", None)
+                    logit_margin = metrics.pop("logit_margin", None)
+                    health_dev = {
+                        k: metrics.pop(k)
+                        for k in [k for k in metrics if k.startswith("h_")]
+                    }
+                    on_health_stride = bool(
+                        config.health_stride
+                        and (global_step - 1) % config.health_stride == 0
+                    )
                     if telemetry is not None:
                         telemetry.timer.mark_dispatch()
                         # stride-gated device fence: off-stride steps stay
@@ -617,6 +649,15 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                     if sentinel is not None:
                         sentinel.observe(global_step, metrics["loss"],
                                          pos=(epoch, i))
+                    if collapse is not None:
+                        obs = {"logit_margin": logit_margin,
+                               "acc1": metrics.get("acc1")}
+                        if on_health_stride:
+                            # stride-gated diagnostics are real only on
+                            # stride steps; feeding the off-stride zeros
+                            # would read as instant collapse
+                            obs.update(health_dev)
+                        collapse.observe(global_step, obs, pos=(epoch, i))
                     if plan is not None:
                         # slow-step drill (ISSUE 8): the sleep lands inside
                         # THIS step's timer window, so the anomaly detector
@@ -711,10 +752,41 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                     throughput.update(config.batch_size)
                     batch_time.update(time.perf_counter() - end)
                     end = time.perf_counter()
+                    health_rec = None
+                    if telemetry is not None and on_health_stride:
+                        # health block for the step record (ISSUE 13):
+                        # pulled to host only on health-stride steps, as
+                        # ONE batched transfer — per-scalar float() would
+                        # pay a device→host round trip each (~70 ms on
+                        # the tunneled relay) × a dozen scalars. Keys
+                        # drop the h_ prefix — obsd rules address them
+                        # as health:<key>.
+                        pull = dict(health_dev)
+                        if logit_margin is not None:
+                            pull["_logit_margin"] = logit_margin
+                            pull["_neg_sim"] = neg_sim
+                            pull["_pos_sim"] = metrics["pos_sim"]
+                            pull["_acc1"] = metrics["acc1"]
+                        host = jax.device_get(pull)
+                        health_rec = {
+                            k[2:]: round(float(v), 6)
+                            for k, v in host.items()
+                            if k.startswith("h_")
+                        }
+                        if logit_margin is not None:
+                            health_rec["logit_margin"] = round(
+                                float(host["_logit_margin"]), 6)
+                            health_rec["neg_sim"] = round(
+                                float(host["_neg_sim"]), 6)
+                            health_rec["pos_sim"] = round(
+                                float(host["_pos_sim"]), 6)
+                            health_rec["acc1"] = round(
+                                float(host["_acc1"]), 4)
                     if telemetry is not None:
                         phases = telemetry.timer.finish_step()
                         if telemetry.on_step(global_step, phases, throughput,
-                                             loss=step_loss):
+                                             loss=step_loss,
+                                             health=health_rec):
                             # flushed: land the TensorBoard curves at the
                             # same cadence (ISSUE 2 satellite)
                             writer.flush()
@@ -732,6 +804,17 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                                     devices=chaos_devices or None,
                                 )
                             resize.trigger()
+                        if plan.maybe_collapse(global_step):
+                            # collapse drill (ISSUE 13): crush the key
+                            # encoder to a constant-feature tree, EVERY
+                            # step from here on — the in-step EMA would
+                            # heal a one-shot crush within one step
+                            from moco_tpu.telemetry.health import (
+                                crush_key_params,
+                            )
+
+                            state = state.replace(
+                                params_k=crush_key_params(state.params_k))
                         # process-level faults (ISSUE 4): SIGKILL-grade death
                         # and wedged-collective freeze — both invisible to
                         # the in-process handlers, recoverable only by the
@@ -769,6 +852,10 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                 # NaN state would be checkpointed — then restored by the very
                 # rollback trying to escape it)
                 sentinel.flush()
+            if collapse is not None:
+                # same reasoning for the collapse predicates: a collapsed
+                # state must not be checkpointed past its own detection
+                collapse.flush()
             if preempted or resized:
                 break  # no epoch eval/save: the emergency checkpoint follows
             # epoch summary stays CUMULATIVE (honest average incl. the
@@ -835,6 +922,8 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
         if sentinel is not None:
             # the final step's loss is still pending (one-step lag)
             sentinel.flush()
+        if collapse is not None:
+            collapse.flush()
     finally:
         # always land the profiler trace and flush buffered scalars,
         # even when the loop raises (debug_nans, data errors, ^C);

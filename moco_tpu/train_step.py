@@ -41,6 +41,7 @@ from moco_tpu.utils.compat import optimization_barrier, shard_map
 
 from moco_tpu.config import PretrainConfig
 from moco_tpu.models import build_resnet
+from moco_tpu.telemetry import health
 from moco_tpu.ops.ema import ema_update, momentum_schedule
 from moco_tpu.ops.losses import (
     contrastive_accuracy,
@@ -226,10 +227,13 @@ def _build_query_loss(config: PretrainConfig, model, temperature: float):
         )
         q = l2_normalize(q)
         logits, labels = infonce_logits(q, k, queue, temperature)
+        # q rides the aux for the health diagnostics (ISSUE 13) — already
+        # computed, and DCE'd by XLA wherever nothing consumes it
         return softmax_cross_entropy(logits, labels), (
             mut_q["batch_stats"],
             logits,
             labels,
+            q,
         )
 
     return query_loss
@@ -315,7 +319,7 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
         def loss_fn(pq):
             return query_loss(pq, stats_q, im_q, k, queue)
 
-        (loss, (new_stats_q, logits, labels)), grads = jax.value_and_grad(
+        (loss, (new_stats_q, logits, labels, q)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params_q)
         # DDP-equivalent gradient sync (mean over the data axis) through the
@@ -332,10 +336,19 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
         # while loss/acc metrics can still look plausible against a
         # frozen-feature queue (measured r5, runs/README.md)
         pos_sim = jnp.mean(logits[:, 0]) * temperature
-        metrics = lax.pmean(
-            {"loss": loss, "acc1": acc1, "acc5": acc5, "pos_sim": pos_sim},
-            DATA_AXIS,
-        )
+        # the contrast the loss works with (ISSUE 13 standard metrics,
+        # popped by the driver like the gs_comm_* probes): a margin
+        # pinned at ~0 is collapse or a degenerate queue
+        neg_sim = health.neg_sim_mean(logits, labels, temperature)
+        metrics = {"loss": loss, "acc1": acc1, "acc5": acc5,
+                   "pos_sim": pos_sim, "neg_sim": neg_sim,
+                   "logit_margin": pos_sim - neg_sim}
+        if config.health_stride:
+            # stride-gated collapse diagnostics (ISSUE 13): they join the
+            # SAME metrics pmean below — no new collectives
+            metrics.update(health.region_health(
+                q, k, grads, step, config.health_stride))
+        metrics = lax.pmean(metrics, DATA_AXIS)
         return payload, gs_new, gs_probe, k, new_stats_q, new_stats_k, metrics
 
     region = shard_map(
@@ -389,6 +402,14 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
             # the stride-gated fence, popped by the driver before display
             gs_comm_pre=gs_probe, gs_comm_post=gradsync.probe_post(grads),
         )
+        if config.health_stride:
+            # replicated-state diagnostics (ISSUE 13) live at the outer
+            # jit level where queue/params are replicated: no collective
+            metrics.update(health.queue_health(
+                state.queue, state.step, config.batch_size,
+                config.health_stride))
+            metrics.update(health.param_drift(
+                state.params_q, params_k, state.step, config.health_stride))
         new_state = state.replace(
             step=state.step + 1,
             params_q=params_q,

@@ -32,6 +32,7 @@ from moco_tpu.ops.ema import ema_update, momentum_schedule
 from moco_tpu.ops.losses import l2_normalize, v3_contrastive_loss
 from moco_tpu.parallel.collectives import all_gather_batch
 from moco_tpu.parallel.mesh import DATA_AXIS
+from moco_tpu.telemetry import health
 from moco_tpu.train_state import TrainState
 from moco_tpu.utils.compat import shard_map
 
@@ -219,9 +220,17 @@ def build_v3_train_step(
         # v1/v2 step's pos_sim (q1/k2 are L2-normalized, so the row-dot is
         # the cosine of the local positive pair)
         pos_sim = jnp.mean(jnp.sum(q1 * k2, axis=-1))
-        metrics = lax.pmean(
-            {"loss": loss, "acc1": acc1, "pos_sim": pos_sim}, DATA_AXIS
-        )
+        # ISSUE 13 standard metrics: the monitoring logits are raw
+        # cosines (no /T), so neg_sim_mean's ×T runs at T=1 here
+        neg_sim = health.neg_sim_mean(logits, labels, 1.0)
+        metrics = {"loss": loss, "acc1": acc1, "pos_sim": pos_sim,
+                   "neg_sim": neg_sim, "logit_margin": pos_sim - neg_sim}
+        if config.health_stride:
+            # stride-gated collapse diagnostics (queue-free v3: no queue
+            # stats) riding the SAME metrics pmean — no new collectives
+            metrics.update(health.region_health(
+                q1, k2, grads, step, config.health_stride))
+        metrics = lax.pmean(metrics, DATA_AXIS)
         return payload, gs_new, gs_probe, new_stats_q, new_stats_k, metrics
 
     region = shard_map(
@@ -249,6 +258,12 @@ def build_v3_train_step(
             metrics, lr=sched(state.step), momentum=m,
             gs_comm_pre=gs_probe, gs_comm_post=gradsync.probe_post(grads),
         )
+        if config.health_stride:
+            # q↔k drift over the EMA-covered subtree (the predictor is
+            # query-only); outer level, replicated: no collective
+            metrics.update(health.param_drift(
+                encoder_subtree(state.params_q), params_k, state.step,
+                config.health_stride))
         return (
             state.replace(
                 step=state.step + 1,

@@ -892,8 +892,12 @@ def test_reload_does_not_let_inflight_old_rows_repopulate_cache():
 
     gate = _threading.Event()
     old = _GatedStubEngine(1.0, gate=gate)
+    # reload_probe=0: the drift guard (ISSUE 13) would block on the gated
+    # old engine inside reload() and break this test's interleaving (and
+    # the constant-row stub IS "collapsed" by construction)
     service = EmbedService(old, flush_ms=1.0, max_queue=16,
-                           request_deadline_ms=30_000.0, cache_mb=4)
+                           request_deadline_ms=30_000.0, cache_mb=4,
+                           reload_probe=0)
     service.set_engine_factory(
         lambda path: _GatedStubEngine(2.0))
     try:

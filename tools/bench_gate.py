@@ -58,6 +58,14 @@ _LOWER_BETTER = ("/final_loss",)
 DEFAULT_TOLERANCE = 0.25       # allowed relative drop (higher-better)
 DEFAULT_LOSS_TOLERANCE = 0.10  # allowed relative rise (lower-better)
 
+# metric-key suffixes gated against an ABSOLUTE cap instead of the
+# trajectory: overhead shares hover near zero, where a relative
+# tolerance would flap on measurement noise (0.02% vs 0.04% is "2×")
+# while the contract is the absolute bound. health_overhead (ISSUE 13):
+# amortized in-graph diagnostics cost must stay under 1% of step p50 at
+# the default stride.
+_ABSOLUTE_CAPS = {"/health_overhead_pct": 1.0}
+
 
 def _iter_metric_records(source) -> list[dict]:
     """Every metric-bearing JSON object in a bench output. `source` is a
@@ -118,6 +126,11 @@ def flatten(source) -> tuple[dict, int]:
             ename = str(e2e.get("metric", f"{name}/e2e"))
             if isinstance(v, (int, float)) and v > 0:
                 flat[ename] = float(v)
+        ho = rec.get("health_overhead")
+        if isinstance(ho, dict):
+            v = ho.get("overhead_pct_of_step_p50")
+            if isinstance(v, (int, float)) and v >= 0:
+                flat[f"{name}/health_overhead_pct"] = float(v)
     return flat, details
 
 
@@ -163,6 +176,16 @@ def gate_record(fresh_flat: dict, trajectory_flats: list[tuple[str, dict]],
     overrides = overrides or {}
     regressions, improvements, passes, new_metrics = [], [], [], []
     for key, value in sorted(fresh_flat.items()):
+        cap = next((c for suffix, c in _ABSOLUTE_CAPS.items()
+                    if key.endswith(suffix)), None)
+        if cap is not None:
+            # absolute-cap metric: the bound IS the contract — no
+            # trajectory baseline needed (and the cap never loosens just
+            # because a committed round measured close to it)
+            cap = overrides.get(key, cap)
+            entry = {"metric": key, "value": value, "cap": cap}
+            (regressions if value > cap else passes).append(entry)
+            continue
         baseline = None
         for round_name, flat in reversed(trajectory_flats):
             if key in flat:
@@ -343,6 +366,10 @@ def main(argv=None) -> int:
         print(json.dumps(verdict))
     else:
         for r in verdict["regressions"]:
+            if "cap" in r:
+                print(f"REGRESSION {r['metric']}: {r['value']} over "
+                      f"absolute cap {r['cap']}")
+                continue
             print(f"REGRESSION {r['metric']}: {r['value']} vs "
                   f"{r['baseline']} ({r['baseline_round']}) — "
                   f"×{r['ratio']} beyond tolerance {r['tolerance']}")
@@ -350,6 +377,10 @@ def main(argv=None) -> int:
             print(f"improved   {r['metric']}: {r['value']} vs "
                   f"{r['baseline']} ({r['baseline_round']}) ×{r['ratio']}")
         for r in verdict["passes"]:
+            if "cap" in r:
+                print(f"ok         {r['metric']}: {r['value']} within "
+                      f"absolute cap {r['cap']}")
+                continue
             print(f"ok         {r['metric']}: {r['value']} vs "
                   f"{r['baseline']} ({r['baseline_round']}) ×{r['ratio']}")
         for name in verdict["new_metrics"]:

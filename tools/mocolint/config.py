@@ -148,6 +148,10 @@ LEGACY_CONFIG = LintConfig(
 BIT_IDENTITY_MODULES = (
     "moco_tpu/train_step.py",
     "moco_tpu/v3_step.py",
+    # ISSUE 13: the in-graph health diagnostics trace INTO the step
+    # program — nondeterminism here would break the health-on == health-
+    # off bitwise-trajectory contract the step tests pin
+    "moco_tpu/telemetry/health.py",
     "moco_tpu/data/augment.py",
     "moco_tpu/data/loader.py",
     "moco_tpu/data/canvas_cache.py",
@@ -168,6 +172,8 @@ STEP_BUILDER_MODULES = (
     "moco_tpu/serve/engine.py",
     "moco_tpu/ops/",
     "moco_tpu/data/augment.py",
+    "moco_tpu/telemetry/health.py",  # ISSUE 13: traced into the step —
+                                     # a host sync here stalls EVERY step
 )
 
 DEFAULT_CONFIG = LintConfig(

@@ -28,7 +28,10 @@ Endpoints (one ThreadingHTTPServer):
     /healthz   liveness
 
 Rule-file reference and the default rule set: README "obsd" + the
-`SLORule` docstring in moco_tpu/telemetry/aggregate.py.
+`SLORule` docstring in moco_tpu/telemetry/aggregate.py. A shipped rule
+file for the learning-health objectives (ISSUE 13 — health:<key> floors
+over the step records' in-graph collapse diagnostics, sentinel
+collapse_events) is tools/slo_rules/learning_health.json.
 
 Pure stdlib, importable without jax/numpy (mocolint R11
 `obsd-stdlib-only`, transitive): obsd must keep answering while the

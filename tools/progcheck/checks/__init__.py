@@ -7,5 +7,6 @@ from tools.progcheck.checks import (  # noqa: F401
     donation,
     dtype_policy,
     gradflow,
+    health,
     wire_bytes,
 )

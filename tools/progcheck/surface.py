@@ -37,6 +37,9 @@ import warnings
 FAMILIES = ("train", "v3", "probe", "gradsync", "serve", "aug_step", "eval",
             "resize")
 RESIZE_MESH_SIZE = 2  # the resized-mesh proxy (the 1→2→1 drill's middle leg)
+HEALTH_STRIDE = 10    # telemetry/health.DEFAULT_STRIDE (literal: the
+                      # surface must enumerate without importing jax-side
+                      # modules at module load)
 
 # the tiny proxy (mirrors tests/test_gradsync.py)
 B, IMG, DIM, K = 16, 16, 16, 64
@@ -128,9 +131,21 @@ def _step_records(mesh, with_cost, family):
     variant = "v1" if family == "train" else "v3"
     records = []
     im = jax.ShapeDtypeStruct((B, IMG, IMG, 3), jnp.float32)
-    for mode in ("fused", "bucketed", "quantized", "demo"):
-        config = _proxy_config(variant=variant, grad_sync=mode,
-                               **GRAD_SYNC_KNOBS)
+    # the health-instrumented variant (ISSUE 13): the fused step with the
+    # stride-gated in-graph diagnostics traced in. Audited as its own
+    # program — P6 proves the diagnostics host no callbacks, P10 that
+    # they added no collective beyond the existing metrics reduction —
+    # and pinned in golden_invariants.json next to its base
+    modes = [("fused", {}), ("bucketed", {}), ("quantized", {}),
+             ("demo", {}),
+             ("fused+health", {"grad_sync": "fused",
+                               "health_stride": HEALTH_STRIDE})]
+    for mode, extra in modes:
+        config = _proxy_config(variant=variant,
+                               grad_sync=extra.get("grad_sync", mode),
+                               **GRAD_SYNC_KNOBS, **{
+                                   k: v for k, v in extra.items()
+                                   if k != "grad_sync"})
         state, model, tx, sched = _state_shapes(config, mesh)
         step = build_train_step(config, model, tx, mesh, 8, sched)
         closed = jax.make_jaxpr(step)(state, im, im)

@@ -106,6 +106,8 @@ def build_service(config: ServeConfig):
         num_classes=config.num_classes,
         knn_k=config.knn_k,
         knn_temperature=config.knn_temperature,
+        reload_probe=config.reload_probe,
+        reload_min_spread=config.reload_min_spread,
     )
     service.set_engine_factory(engine_factory)
     return service, registry

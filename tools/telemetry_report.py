@@ -43,6 +43,12 @@ Renders, from the schema-versioned record stream the driver writes
     tools/obsd.py appends into the same stream, folded per rule
     (alert/recovery counts, still-active rules) as a `slo:` section —
     and rendered live by --follow, like fleet/resize lines
+  - learning health (ISSUE 13): the `health` blocks the driver stamps on
+    health-stride step records (embedding std / participation ratio,
+    logit margin, queue norm/age, q↔k drift — telemetry/health.py) plus
+    CollapseSentinel incident/recovery events, folded as a `health:`
+    section (last sample + window-worst floors) — and rendered live by
+    --follow as their own `health:` tail lines
   - pod-record count and worst cross-host step-time spread
 
 `--follow` (ISSUE 8 satellite) is the live-tail mode: poll the file and
@@ -301,11 +307,50 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
         summary["serve"]["snapshots"] = len(serves)
     if fleet:
         summary["fleet"] = _summarize_fleet(fleet, serves)
+    health_sec = _summarize_health(steps, events)
+    if health_sec:
+        summary["health"] = health_sec
     if slos:
         summary["slo"] = _summarize_slo(slos)
     if run_ends:
         summary["run_end"] = run_ends[-1]
     return summary
+
+
+def _summarize_health(steps: list[dict], events: list[dict]) -> dict | None:
+    """Fold the learning-health story (ISSUE 13): the `health` blocks the
+    driver stamps onto health-stride step records (in-graph collapse
+    diagnostics — telemetry/health.py documents each key) plus the
+    CollapseSentinel's `health` incident/recovery events. None when the
+    run carried neither (health_stride=0 and no sentinel armed)."""
+    blocks = [(r.get("step"), r["health"]) for r in steps
+              if isinstance(r.get("health"), dict)]
+    incidents = [e for e in events if e.get("event") == "health"]
+    recoveries = [e for e in events if e.get("event") == "health_recovered"]
+    if not blocks and not incidents and not recoveries:
+        return None
+    sec: dict = {"samples": len(blocks)}
+    if blocks:
+        sec["last"] = dict(blocks[-1][1])
+        # collapse is a FLOOR violation: the window's worst (lowest)
+        # margin/std tells the story the last sample can hide
+        for key in ("logit_margin", "emb_std_q", "emb_std_k",
+                    "qnorm_min", "acc1"):
+            vals = [b[key] for _, b in blocks
+                    if isinstance(b.get(key), (int, float))]
+            if vals:
+                sec.setdefault("min", {})[key] = min(vals)
+    if incidents or recoveries:
+        sec["incidents"] = {
+            "fired": len(incidents),
+            "recovered": len(recoveries),
+            "predicates": [
+                {k: e[k] for k in ("predicate", "step", "value",
+                                   "threshold", "window") if k in e}
+                for e in incidents[-8:]
+            ],
+        }
+    return sec
 
 
 def _summarize_slo(slos: list[dict]) -> dict:
@@ -721,6 +766,43 @@ def render(summary: dict) -> str:
                    f"({', '.join(str(h.get('step')) for h in quarantined[-6:])})"
                    if quarantined else "")
             )
+    health = summary.get("health")
+    if health:
+        last = health.get("last", {})
+        parts = [f"health: {health['samples']} sample(s)"]
+        if "logit_margin" in last:
+            worst = health.get("min", {}).get("logit_margin")
+            parts.append(
+                f"margin {last['logit_margin']:.4f}"
+                + (f" (min {worst:.4f})" if worst is not None else "")
+            )
+        if "emb_std_k" in last:
+            parts.append(
+                f"emb std q/k {last.get('emb_std_q', 0):.4f}/"
+                f"{last['emb_std_k']:.4f}"
+            )
+        if "pdrift" in last:
+            parts.append(f"q-k drift {last['pdrift']:.4f}")
+        lines.append(" · ".join(parts))
+        if "qnorm_mean" in last:
+            lines.append(
+                f"  queue: norm mean {last['qnorm_mean']:.4f} min "
+                f"{last.get('qnorm_min', 0):.4f} · age "
+                f"{last.get('qage_steps', 0):.0f} step(s)"
+                + (f" · participation ratio {last['emb_pr_q']:.1f}"
+                   if "emb_pr_q" in last else "")
+            )
+        inc = health.get("incidents")
+        if inc:
+            preds = ", ".join(
+                f"{p.get('predicate', '?')}@{p.get('step', '?')}"
+                for p in inc.get("predicates", ())
+            )
+            lines.append(
+                f"  collapse incidents: {inc['fired']} fired"
+                + (f" ({preds})" if preds else "")
+                + f" · {inc['recovered']} recovered"
+            )
     slo = summary.get("slo")
     if slo:
         active = slo.get("active", [])
@@ -799,7 +881,21 @@ def render_record(rec: dict) -> str | None:
             parts.append(f"loss {rec['loss']:.4f}"
                          if isinstance(rec["loss"], float)
                          else f"loss {rec['loss']}")
-        return "  ".join(parts)
+        line = "  ".join(parts)
+        health = rec.get("health")
+        if isinstance(health, dict):
+            # learning-health stride sample (ISSUE 13): its own tail line
+            # so a margin sliding toward 0 jumps out of the step stream
+            hp = [f"health: step {rec.get('step', '?'):>6}"]
+            for key, label in (("logit_margin", "margin"),
+                               ("emb_std_q", "std_q"),
+                               ("emb_std_k", "std_k"),
+                               ("qnorm_min", "qnorm_min"),
+                               ("pdrift", "drift")):
+                if isinstance(health.get(key), (int, float)):
+                    hp.append(f"{label} {health[key]:.4f}")
+            line += "\n" + "  ".join(hp)
+        return line
     if kind == "event":
         name = rec.get("event", "?")
         detail = " ".join(
