@@ -285,7 +285,11 @@ def _orchestrate_body(mode: str, orch: "_Orchestrator") -> None:
             if e2e is not None:
                 orch.extras["e2e"] = {k: e2e[k] for k in
                                       ("metric", "value", "unit",
-                                       "vs_baseline", "input_pipeline")
+                                       "vs_baseline", "input_pipeline",
+                                       # ISSUE 14: the service + prestage
+                                       # rows and their shared ceiling
+                                       "service", "prestage",
+                                       "device_bound_imgs_per_sec_per_chip")
                                       if k in e2e}
         else:
             orch.errors.append("e2e: skipped, step attempt consumed the budget")
@@ -507,12 +511,10 @@ def bench_e2e():
     dataset = CachedDataset(inner, cache_mb)
     fused, state = build_v2_fused_step(config, mesh)
 
-    def run_epoch(epoch, max_steps):
+    def drive_loader(loader, max_steps):
         nonlocal state
         n = 0
         metrics = None
-        loader = epoch_loader(dataset, epoch, 0, batch, mesh,
-                              workers=workers, depth=depth, trim_h2d=True)
         try:
             for imgs, _labels, extents in loader:
                 state, metrics = fused(state, imgs, extents, n)
@@ -526,11 +528,20 @@ def bench_e2e():
             loader.close_quietly()
         if metrics is None:
             raise RuntimeError(
-                f"epoch_loader yielded zero batches (epoch {epoch}, "
-                f"batch {batch}, {len(dataset)} images)")
+                f"loader yielded zero batches (batch {batch}, "
+                f"{len(dataset)} images)")
         loss = float(metrics["loss"])  # d2h sync (block_until_ready lies on the relay)
         assert np.isfinite(loss), f"non-finite e2e loss {loss}"
         return n
+
+    def run_epoch(epoch, max_steps, ds=None, trim=True):
+        loader = epoch_loader(ds if ds is not None else dataset, epoch, 0,
+                              batch, mesh, workers=workers, depth=depth,
+                              trim_h2d=trim)
+        try:
+            return drive_loader(loader, max_steps)
+        finally:
+            loader.close_quietly()  # idempotent: drive_loader closed it
 
     t_c = time.perf_counter()
     # warm a FULL epoch: compiles the (one, trimmed) step shape AND fills
@@ -542,30 +553,212 @@ def bench_e2e():
     dt = time.perf_counter() - t0
     per_chip = batch * n / dt / n_chips
     lookups = dataset.hits + dataset.misses
-    print(
-        json.dumps(
-            {
-                "metric": "moco_v2_r50_e2e_input_fed_throughput_per_chip"
-                if on_tpu
-                else "moco_v2_tiny_cpu_e2e_proxy_per_chip",
-                "value": round(per_chip, 2),
-                "unit": "imgs/sec/chip",
-                "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
-                # evidence for sizing the TPU window (VERDICT r4 #2): how
-                # long compile+warmup actually took on THIS backend
-                "compile_warmup_s": round(compile_warmup_s, 1),
-                # the ISSUE 3 pipeline shape this number was measured with
-                "input_pipeline": {
-                    "staging_workers": workers,
-                    "prefetch_depth": depth,
-                    "input_cache_mb": cache_mb,
-                    "h2d_trim": True,
-                    "cache_hit_rate": round(dataset.hits / lookups, 3)
-                    if lookups else 0.0,
-                },
-            }
+    record = {
+        "metric": "moco_v2_r50_e2e_input_fed_throughput_per_chip"
+        if on_tpu
+        else "moco_v2_tiny_cpu_e2e_proxy_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+        # evidence for sizing the TPU window (VERDICT r4 #2): how
+        # long compile+warmup actually took on THIS backend
+        "compile_warmup_s": round(compile_warmup_s, 1),
+        # the ISSUE 3 pipeline shape this number was measured with
+        "input_pipeline": {
+            "staging_workers": workers,
+            "prefetch_depth": depth,
+            "input_cache_mb": cache_mb,
+            "h2d_trim": True,
+            "cache_hit_rate": round(dataset.hits / lookups, 3)
+            if lookups else 0.0,
+        },
+    }
+    # provisional line FIRST (the orchestrate() convention — consumers
+    # take the LAST json line): the measured headline must survive a
+    # budget kill anywhere in the probe/service/prestage rows below
+    # (the device-bound probe compiles a NEW untrimmed shape on TPU)
+    print(json.dumps(record), flush=True)
+    # device-bound step rate: the same fused step over one ALREADY-STAGED
+    # batch — the ceiling any input pipeline is chasing (the prestage
+    # acceptance bar is 0.9x of THIS, measured in the same round)
+    device_bound = None
+    staged = d_imgs = d_exts = None
+    try:
+        staged = []
+        loader = epoch_loader(dataset, 2, 0, batch, mesh, workers=workers,
+                              depth=depth, trim_h2d=False)
+        try:
+            for item in loader:
+                staged.append(item)
+                break
+        finally:
+            loader.close_quietly()
+        d_imgs, _d_labels, d_exts = staged[0]
+        # thread `state` through: the fused step DONATES its input state,
+        # so a copy under another name would leave `state` a deleted
+        # buffer for the service/prestage rows that run after this
+        state, m = fused(state, d_imgs, d_exts, 0)  # compile
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, m = fused(state, d_imgs, d_exts, i)
+        float(m["loss"])
+        db_dt = time.perf_counter() - t0
+        device_bound = batch * steps / db_dt / n_chips
+        record["device_bound_imgs_per_sec_per_chip"] = round(device_bound, 2)
+    except Exception as e:  # noqa: BLE001 — a failed row must not void the headline
+        record["device_bound_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        # release the probe batch EVEN when the probe failed: a full
+        # per-host canvas batch pinned in HBM would add pressure to the
+        # service/prestage rows measured next
+        staged = d_imgs = d_exts = None  # noqa: F841
+    print(json.dumps(record), flush=True)  # headline + device-bound row
+    record["service"] = _bench_e2e_service(
+        root, stage_size, cache_mb, len(dataset), batch, mesh, n_chips,
+        on_tpu, depth, workers, steps, n_images, drive_loader)
+    record["prestage"] = _bench_e2e_prestage(
+        inner, batch, n_chips, on_tpu, steps, n_images, device_bound,
+        run_epoch)
+    print(json.dumps(record), flush=True)
+
+
+def _bench_e2e_service(root, stage_size, cache_mb, dataset_len, batch,
+                       mesh, n_chips, on_tpu, depth, workers, steps,
+                       n_images, drive_loader) -> dict:
+    """The disaggregated-service e2e row (ISSUE 14): the SAME fused step
+    fed by a ServiceClient over 2 real LocalServerPool staging servers
+    (stdlib supervisor + decode-worker subprocess each) on this host. A
+    warm epoch fills the server-side decode-once caches and compiles the
+    untrimmed canvas shape; the timed epoch is the service steady state.
+    Never raises — a dead pool reports {"error": ...} and the in-process
+    headline stands."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    out: dict = {
+        "metric": "moco_v2_r50_e2e_service_throughput_per_chip"
+        if on_tpu else "moco_v2_tiny_cpu_e2e_service_proxy_per_chip",
+        "unit": "imgs/sec/chip",
+        "servers": 2,
+    }
+    svc_root = ""
+    pool = None
+    try:
+        # everything inside the try: the docstring's never-raises
+        # contract covers construction too (health-port bind, tracer
+        # dirs) AND the moco_tpu imports — a stripped deployment must
+        # degrade to an {"error": ...} row, not skip the prestage row
+        # and the consolidated record
+        from moco_tpu.data.service.client import service_epoch_loader
+        from moco_tpu.data.service.fleet import LocalServerPool
+
+        svc_root = _tempfile.mkdtemp(prefix="bench_svc_")
+        worker_args = ["--dataset", "imagefolder", "--data-dir", root,
+                       "--cache-mb", str(cache_mb)]
+        if stage_size:
+            worker_args += ["--stage-size", str(stage_size)]
+        pool = LocalServerPool(2, worker_args, telemetry_root=svc_root)
+        pool.start()
+        if not pool.wait_healthy(90.0):
+            raise RuntimeError("staging-server pool never became healthy")
+
+        def run_service_epoch(epoch, max_steps):
+            loader = service_epoch_loader(
+                pool.endpoints_spec(), dataset_len, epoch, 0, batch,
+                mesh, depth=depth, streams=workers)
+            try:
+                return drive_loader(loader, max_steps)
+            finally:
+                loader.close_quietly()  # idempotent: drive_loader closed it
+
+        run_service_epoch(0, n_images // batch)  # warm: caches + compile
+        t0 = time.perf_counter()
+        n = run_service_epoch(1, steps)
+        dt = time.perf_counter() - t0
+        per_chip = batch * n / dt / n_chips
+        out["value"] = round(per_chip, 2)
+        out["vs_baseline"] = round(
+            per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3)
+        # per-server rows (noisy detail — bench_gate excludes them the
+        # way it excludes per-thread input rows). A LIVE pong snapshot,
+        # not the supervisor's cached probe: the timed epoch fits inside
+        # one probe period, so the cache still shows the pre-shard zeros
+        from moco_tpu.data.service import protocol as _protocol
+
+        detail = {}
+        for server in pool.servers:
+            stats = (_protocol.ping(server.host, server.data_port,
+                                    timeout_s=5.0)
+                     or server.stats().get("worker_stats", {}))
+            sid = server.server_id
+            for key in ("shards", "streamed_mb", "shard_s_p50",
+                        "shard_s_p95", "cache_hit_rate"):
+                if key in stats:
+                    detail[f"server{sid}_{key}"] = stats[key]
+        out["detail"] = detail
+    except Exception as e:  # noqa: BLE001
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if pool is not None:
+            pool.close_quietly()
+        # the record already captured the per-server detail: the
+        # telemetry dirs + worker logs must not accumulate in /tmp
+        # across gate runs (the prestage sibling's rmtree discipline)
+        if svc_root:
+            _shutil.rmtree(svc_root, ignore_errors=True)
+    return out
+
+
+def _bench_e2e_prestage(inner, batch, n_chips, on_tpu, steps, n_images,
+                        device_bound, run_epoch) -> dict:
+    """The pre-staged epoch-cache e2e row (ISSUE 14): decode the whole
+    tree ONCE into the mmap prestage format, then run the same fused
+    step over a PrestagedDataset — a hit epoch is row gathers at memcpy
+    speed, so this row is expected to sit within 0.9x of the
+    device-bound step rate (the ISSUE acceptance bar, recorded as
+    `vs_device_bound`). Never raises."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    out: dict = {
+        "metric": "moco_v2_r50_e2e_prestage_throughput_per_chip"
+        if on_tpu else "moco_v2_tiny_cpu_e2e_prestage_proxy_per_chip",
+        "unit": "imgs/sec/chip",
+    }
+    pre_root = _tempfile.mkdtemp(prefix="bench_prestage_")
+    try:
+        # imports inside the try: never-raises covers a stripped
+        # deployment too — degrade to the {"error": ...} row
+        from moco_tpu.data.service.prestage import (
+            PrestagedDataset,
+            write_prestage,
         )
-    )
+
+        t0 = time.perf_counter()
+        write_prestage(inner, pre_root)
+        out["prestage_write_s"] = round(time.perf_counter() - t0, 1)
+        pre = PrestagedDataset(pre_root)
+        # trim=False: the device-bound ceiling this row is ratioed
+        # against (and the service row) runs the UNTRIMMED step shape —
+        # a trimmed epoch would inflate vs_device_bound by comparing a
+        # cheaper compiled program against the full-canvas one
+        run_epoch(0, n_images // batch, ds=pre, trim=False)  # warm mmap
+        t0 = time.perf_counter()
+        n = run_epoch(1, steps, ds=pre, trim=False)
+        dt = time.perf_counter() - t0
+        per_chip = batch * n / dt / n_chips
+        out["value"] = round(per_chip, 2)
+        out["vs_baseline"] = round(
+            per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3)
+        if device_bound:
+            out["device_bound"] = round(device_bound, 2)
+            out["vs_device_bound"] = round(per_chip / device_bound, 3)
+    except Exception as e:  # noqa: BLE001
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        _shutil.rmtree(pre_root, ignore_errors=True)
+    return out
 
 
 def bench_serve():

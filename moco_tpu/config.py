@@ -124,6 +124,36 @@ class PretrainConfig:
                                       # for content that underfills the
                                       # canvas. Single-host only; each new
                                       # trimmed shape compiles once
+    # disaggregated input service (ISSUE 14 — see README "Input service")
+    input_service: str = ""           # "host:port,host:port" staging-server
+                                      # data endpoints: epoch batches are
+                                      # fetched from standalone decode
+                                      # servers (ServiceClient) instead of
+                                      # decoded in-process — bit-identical
+                                      # to in-process staging on the same
+                                      # seed/epoch. "" = in-process.
+                                      # Rejected with h2d_trim: trimming
+                                      # is a client-side canvas slice whose
+                                      # shape grid the remote shard frames
+                                      # do not carry — progcheck P9's
+                                      # bounded-compile-set contract stays
+                                      # with the in-process path
+    input_prestage: str = ""          # pre-staged epoch cache directory
+                                      # (tools/prestage.py output) served
+                                      # by the IN-PROCESS Prefetcher: the
+                                      # dataset becomes mmap row gathers —
+                                      # decode-once for the whole cluster.
+                                      # (Staging servers take the same
+                                      # directory via --prestage.)
+    input_request_timeout_s: float = 30.0
+                                      # one service shard round-trip bound
+                                      # before the client tears the link
+                                      # and re-lands the shard elsewhere.
+                                      # Size ABOVE the slowest honest
+                                      # shard decode: a timeout restarts
+                                      # the decode from scratch on the
+                                      # next server, so a bound below it
+                                      # exhausts retries deterministically
     # optimization (reference: SGD momentum .9, wd 1e-4, lr .03, batch 256)
     optimizer: str = "sgd"            # sgd | adamw | lars
     lr: float = 0.03                  # absolute lr; 0.0 = derive from base_lr
@@ -289,6 +319,39 @@ class PretrainConfig:
             raise ValueError(
                 f"input_cache_mb must be >= 0, got {self.input_cache_mb}"
             )
+        # input-service knobs (ISSUE 14): a typo'd endpoint list must fail
+        # where it was written, not as an unreachable-server stall mid-run.
+        # The parser lives in the stdlib service protocol module — a
+        # function-level import, so config stays importable without jax
+        if self.input_request_timeout_s <= 0:
+            raise ValueError(
+                "input_request_timeout_s must be > 0, got "
+                f"{self.input_request_timeout_s}"
+            )
+        if self.input_service:
+            from moco_tpu.data.service.protocol import parse_endpoints
+
+            parse_endpoints(self.input_service)  # raises ValueError
+            if self.h2d_trim:
+                raise ValueError(
+                    "input_service and h2d_trim are mutually exclusive: "
+                    "extent-trimming slices the staged canvas CLIENT-side "
+                    "into a shape grid the remote shard frames do not "
+                    "carry — run the service with full canvases (the "
+                    "remote decode is what h2d_trim's savings came from) "
+                    "or trim in-process"
+                )
+            if self.input_prestage:
+                raise ValueError(
+                    "input_service and input_prestage are mutually "
+                    "exclusive on the train host: the service loader "
+                    "would feed training while the prestage sat unused "
+                    "as a len() source — a same-length-different-data "
+                    "server pool would pass the meta check and silently "
+                    "train off the pinned cache. Point the staging "
+                    "servers at it instead "
+                    "(tools/staging_server.py --prestage <dir>)"
+                )
         # grad-sync knobs (ISSUE 6): literals kept in sync with
         # parallel/gradsync.GRAD_SYNC_MODES — config must stay importable
         # without jax (the serve/stdlib processes)

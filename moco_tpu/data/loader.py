@@ -189,14 +189,15 @@ class Prefetcher:
                 with self._tracer.span("decode_slice", cat="input",
                                        detail=True, parent=trace_ctx,
                                        batch=b, lo=lo, hi=hi):
-                    self._read_slice_into(b, idx, canvas, lo, hi)
+                    self._read_slice_into(b, idx, canvas, lo, hi,
+                                          trace_ctx=trace_ctx)
                 collector.done_ok(lo)
             except BaseException as e:  # routed, not swallowed: the
                 # coordinator re-raises (or exits quietly on close)
                 collector.done_err(e)
 
     def _read_slice_into(self, b: int, idx: np.ndarray, canvas: _Canvas,
-                         lo: int, hi: int):
+                         lo: int, hi: int, trace_ctx=None):
         """Decode `idx` into canvas rows [lo, hi) with the same
         retry-with-backoff policy as `_read_batch` — per SUB-SLICE, so a
         transient fault in one worker retries only its rows while the rest
@@ -547,8 +548,17 @@ class Prefetcher:
         return jax.device_put(arr, sharding)
 
     def __iter__(self) -> Iterator:
+        """Pop finished device batches, booking credit stalls: time the
+        consumer spends blocked on an EMPTY ready queue is the pipeline
+        (in-process or service) failing to keep the device fed — the
+        obsd `input_credit_stall_rate` input (ISSUE 14)."""
         while True:
-            item = self._q.get()
+            if self._stats is not None and self._q.empty():
+                t0 = time.perf_counter()
+                item = self._q.get()
+                self._stats.note_credit_stall(time.perf_counter() - t0)
+            else:
+                item = self._q.get()
             if item is None:
                 if self._err is not None:
                     self._err_delivered = True

@@ -1,0 +1,55 @@
+"""moco_tpu.data.service — the disaggregated input service (ISSUE 14).
+
+    protocol.py   length-prefixed frame protocol + probe ping (stdlib)
+    worker.py     decode worker subprocess: data port, numpy + native
+                  chunked pool, chaos hooks, per-server stats/spans
+    server.py     stdlib supervisor half: health HTTP endpoint, worker
+                  lifecycle (probe / staleness kill / budgeted restart)
+    client.py     ServiceClient — Prefetcher's drop-in sibling on the
+                  train host (bit-identical staging over sockets)
+    prestage.py   mmap-able pre-staged epoch cache (decode-once format)
+    fleet.py      local N-server pool helper (tests, bench, drills)
+
+LAZY (PEP 562, the serve/telemetry __init__ pattern): the control plane
+(`server.py`, `tools/staging_server.py`) is stdlib-only by contract
+(mocolint R11 `staging-server-stdlib-only` walks ancestor __init__s), so
+nothing here may eagerly import the numpy/jax halves."""
+
+from __future__ import annotations
+
+import importlib
+
+_EXPORTS = {
+    "FrameError": "protocol",
+    "RemoteShardError": "protocol",
+    "parse_endpoints": "protocol",
+    "ServiceClient": "client",
+    "ServiceConfigError": "client",
+    "service_epoch_loader": "client",
+    "PrestageError": "prestage",
+    "PrestagedDataset": "prestage",
+    "write_prestage": "prestage",
+    "DecodeWorker": "worker",
+    "StagingServer": "server",
+    "LocalServerPool": "fleet",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        submodule = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    value = getattr(
+        importlib.import_module(f"{__name__}.{submodule}"), name
+    )
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

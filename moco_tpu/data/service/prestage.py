@@ -1,0 +1,216 @@
+"""Pre-staged epoch cache: decode-once, mmap-served canvases (ISSUE 14).
+
+The staged canvas is a pure deterministic function of the file bytes
+(every randomized transform runs ON DEVICE — the canvas_cache.py
+argument), so the degenerate cache-everything case is to decode the
+WHOLE dataset once, offline, into a packed fixed-shape memmap that every
+epoch of every run on every host then serves at memcpy speed:
+
+    <root>/
+        canvases.u8     [N, H, W, 3] uint8, C-order     (np.memmap)
+        extents.i32     [N, 3] int32 (valid_h, valid_w, rot)
+        labels.i32      [N] int32
+        meta.json       geometry + counts + fingerprint — written LAST
+                        (atomic rename), so its presence IS the
+                        completeness marker (the integrity-manifest
+                        convention: a killed writer leaves no meta, and
+                        a loader refuses the directory loudly)
+
+`PrestagedDataset` speaks the repo's standard batch protocol
+(`get_batch` / `get_batch_into` / `labels` / `__len__`), so it plugs
+into BOTH consumers unchanged: the in-process `Prefetcher` (point
+`--input-prestaged` at the root) and the staging server's decode worker
+(`tools/staging_server.py --prestage`). Rows are stored in DATASET INDEX
+order — not permutation order — so ONE prestage serves every epoch,
+every `skip_batches` fast-forward and every NaN-rollback data-window
+advance: an epoch is just row gathers against the mmap.
+
+Bit-identity: a prestaged batch equals the freshly-decoded batch exactly
+(test-enforced) because the bytes ARE the decode output, copied once.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+META_FILENAME = "meta.json"
+CANVASES_FILENAME = "canvases.u8"
+EXTENTS_FILENAME = "extents.i32"
+LABELS_FILENAME = "labels.i32"
+
+FORMAT_VERSION = 1
+
+
+class PrestageError(ValueError):
+    """The directory is not a complete, consistent prestage (missing
+    meta, truncated payload, geometry mismatch). Deliberately loud: a
+    half-written prestage silently decoded as zeros would poison a run
+    the way the decode-failure meter exists to prevent."""
+
+
+def _paths(root: str) -> dict:
+    return {name: os.path.join(root, fname) for name, fname in (
+        ("meta", META_FILENAME), ("canvases", CANVASES_FILENAME),
+        ("extents", EXTENTS_FILENAME), ("labels", LABELS_FILENAME),
+    )}
+
+
+def write_prestage(dataset, root: str, *, chunk: int = 64,
+                   progress=None) -> dict:
+    """Decode `dataset` (standard batch protocol) into a prestage at
+    `root`. Decodes in `chunk`-row slices straight into the memmap —
+    `get_batch_into` when the dataset supports it (the native C++ path
+    then writes the final bytes in place), else `get_batch` + copy.
+    Returns the meta dict. `progress(done, total)` is an optional
+    callback (the CLI's progress line).
+
+    A decode FAILURE anywhere aborts the write: a prestage is a
+    whole-cluster artifact consumed for months — one zero canvas frozen
+    into it would out-poison any runtime blip (`decode_abort_rate`
+    guards runtime decode; the offline writer holds the stricter line).
+    """
+    n = len(dataset)
+    if n == 0:
+        raise PrestageError("refusing to prestage an empty dataset")
+    probe, _labels, _extents = dataset.get_batch(np.asarray([0]))
+    img_shape = tuple(int(d) for d in probe.shape[1:])
+    if probe.dtype != np.uint8:
+        raise PrestageError(
+            f"prestage expects uint8 canvases, got {probe.dtype}"
+        )
+    os.makedirs(root, exist_ok=True)
+    paths = _paths(root)
+    if os.path.exists(paths["meta"]):
+        raise PrestageError(
+            f"{root!r} already holds a complete prestage; remove it "
+            "first (never silently overwrite a whole-cluster artifact)"
+        )
+    canvases = np.lib.format.open_memmap(
+        paths["canvases"], mode="w+", dtype=np.uint8,
+        shape=(n,) + img_shape,
+    )
+    extents = np.lib.format.open_memmap(
+        paths["extents"], mode="w+", dtype=np.int32, shape=(n, 3),
+    )
+    labels = np.lib.format.open_memmap(
+        paths["labels"], mode="w+", dtype=np.int32, shape=(n,),
+    )
+    fail_before = getattr(dataset, "decode_failures", 0)
+    into = hasattr(dataset, "get_batch_into")
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        idx = np.arange(lo, hi)
+        if into:
+            labels[lo:hi] = dataset.get_batch_into(
+                idx, canvases[lo:hi], extents[lo:hi]
+            )
+        else:
+            imgs, labs, exts = dataset.get_batch(idx)
+            canvases[lo:hi] = imgs
+            extents[lo:hi] = exts
+            labels[lo:hi] = labs
+        if progress is not None:
+            progress(hi, n)
+    failed = getattr(dataset, "decode_failures", 0) - fail_before
+    if failed:
+        raise PrestageError(
+            f"{failed} decode failure(s) during prestage — refusing to "
+            "freeze zero canvases into a whole-cluster artifact"
+        )
+    canvases.flush()
+    extents.flush()
+    labels.flush()
+    meta = {
+        "v": FORMAT_VERSION,
+        "n": n,
+        "img_shape": list(img_shape),
+        "img_dtype": "uint8",
+        "num_classes": int(getattr(dataset, "num_classes", 0)),
+        "image_size": int(getattr(dataset, "image_size", img_shape[0])),
+        "stage_h": int(getattr(dataset, "stage_h", img_shape[0])),
+        "stage_w": int(getattr(dataset, "stage_w", img_shape[1])),
+        "canvas_bytes": int(canvases.nbytes),
+        "source": type(getattr(dataset, "dataset", dataset)).__name__,
+    }
+    tmp = paths["meta"] + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, paths["meta"])  # meta lands LAST, atomically
+    return meta
+
+
+class PrestagedDataset:
+    """Serve a prestage directory through the standard batch protocol.
+
+    Canvases are an `np.memmap` (`mmap=True`, the default): the OS page
+    cache is the only copy, shared across every Prefetcher, staging
+    server and eval loader on the host — a "hit epoch" costs one memcpy
+    per row and zero decode. `mmap=False` loads everything into
+    anonymous memory up front (small datasets, or hosts whose storage
+    is slower than RAM refills)."""
+
+    def __init__(self, root: str, *, mmap: bool = True):
+        paths = _paths(root)
+        if not os.path.exists(paths["meta"]):
+            raise PrestageError(
+                f"{root!r} has no {META_FILENAME} — not a (complete) "
+                "prestage; the writer lands meta last, so a missing "
+                "meta means a killed or still-running write_prestage"
+            )
+        with open(paths["meta"], encoding="utf-8") as f:
+            self.meta = json.load(f)
+        if self.meta.get("v") != FORMAT_VERSION:
+            raise PrestageError(
+                f"prestage format v{self.meta.get('v')} != "
+                f"v{FORMAT_VERSION} reader"
+            )
+        self.root = root
+        mode = "r"
+        self.images = np.load(paths["canvases"],
+                              mmap_mode=mode if mmap else None)
+        self._extents = np.load(paths["extents"],
+                                mmap_mode=mode if mmap else None)
+        self.labels = np.asarray(np.load(paths["labels"]), np.int32)
+        n = int(self.meta["n"])
+        shape = (n,) + tuple(self.meta["img_shape"])
+        if (self.images.shape != shape or self.images.dtype != np.uint8
+                or self._extents.shape != (n, 3)
+                or self.labels.shape != (n,)):
+            raise PrestageError(
+                f"prestage payload disagrees with meta: canvases "
+                f"{self.images.shape}/{self.images.dtype} vs {shape}/"
+                f"uint8, extents {self._extents.shape}, labels "
+                f"{self.labels.shape}"
+            )
+        self.num_classes = int(self.meta.get("num_classes", 0))
+        self.image_size = int(self.meta.get("image_size", shape[1]))
+        self.stage_h = int(self.meta.get("stage_h", shape[1]))
+        self.stage_w = int(self.meta.get("stage_w", shape[2]))
+
+    def __len__(self):
+        return int(self.meta["n"])
+
+    def get_batch(self, indices):
+        idx = np.asarray(indices)
+        # fancy-indexing a memmap materializes real arrays (the one copy)
+        return (
+            np.asarray(self.images[idx]),
+            self.labels[idx],
+            np.asarray(self._extents[idx]),
+        )
+
+    def get_batch_into(self, indices, out_imgs: np.ndarray,
+                       out_extents: np.ndarray) -> np.ndarray:
+        """Memcpy rows straight into caller-owned canvas rows (the
+        staging-canvas protocol): the steady state the service's "hit
+        epoch" promise is made of."""
+        idx = [int(i) for i in indices]
+        for j, i in enumerate(idx):
+            out_imgs[j] = self.images[i]
+            out_extents[j] = self._extents[i]
+        return self.labels[np.asarray(idx)]

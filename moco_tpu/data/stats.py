@@ -57,6 +57,7 @@ class InputPipelineStats:
         self._worker_busy_s = 0.0
         self.cache_hits = 0
         self.cache_misses = 0
+        self._credit_stall_s = 0.0
 
     # -- producers ----------------------------------------------------------
     def note_workers(self, n: int) -> None:
@@ -85,6 +86,16 @@ class InputPipelineStats:
             self.cache_hits += int(hits)
             self.cache_misses += int(misses)
 
+    def note_credit_stall(self, seconds: float) -> None:
+        """Consumer-side starvation (ISSUE 14): time the training loop
+        spent blocked on an EMPTY ready queue with its whole credit
+        window outstanding — the input pipeline (in-process or service)
+        could not keep the device fed. The obsd
+        `input_credit_stall_rate` objective is the windowed rate of this
+        counter: a sustained high rate IS a starving train host."""
+        with self._lock:
+            self._credit_stall_s += float(seconds)
+
     # -- consumer -----------------------------------------------------------
     def snapshot(self) -> dict:
         """One JSON-ready dict of everything above (cumulative)."""
@@ -108,6 +119,10 @@ class InputPipelineStats:
                 "worker_busy_frac": round(
                     self._worker_busy_s / (self.workers * wall), 4
                 ),
+                # cumulative pair: obsd's input_credit_stall_rate takes
+                # the windowed DELTA ratio of these two
+                "credit_stall_s": round(self._credit_stall_s, 3),
+                "wall_s": round(wall, 3),
             }
             if total_lookups:
                 snap["cache_hits"] = self.cache_hits

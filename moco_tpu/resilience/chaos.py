@@ -125,6 +125,30 @@ class ChaosPlan:
                                             # every step: the EMA would
                                             # otherwise heal it within one
                                             # step), logged once.
+    kill_at_shard: int | None = None        # staging-server-side (ISSUE 14
+                                            # input-service drills): self-
+                                            # SIGKILL after the k-th served
+                                            # shard request — a decode
+                                            # worker dying mid-epoch; the
+                                            # client's retry-on-another-
+                                            # server and the staging
+                                            # supervisor's relaunch recover
+                                            # it. Fire-once via
+                                            # MOCO_TPU_CHAOS_STATE like
+                                            # kill_at_request, so the
+                                            # relaunched worker (which
+                                            # re-counts shards from 0) is
+                                            # never re-poisoned into a
+                                            # crash loop
+    stall_at_shard: int | None = None       # staging-server-side: the k-th
+                                            # served shard stalls stall_ms
+                                            # before answering (fire-once,
+                                            # marker-persisted) — the slow-
+                                            # server mode the client's
+                                            # request timeout + retry-on-
+                                            # another-server exists for
+    stall_ms: int = 1000                    # how long the stalled shard
+                                            # holds its answer
     wedge_at_request: int | None = None     # serve-side: after the k-th
                                             # admitted request, STOP answering
                                             # (every later HTTP request —
@@ -213,6 +237,35 @@ class ChaosPlan:
                 and self._fire_once("kill_request")):
             log_event("chaos", f"injecting SIGKILL at request {n_requests}")
             os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_kill_shard(self, n_shards: int) -> None:
+        """Staging-server SIGKILL after the n-th served shard (fire-once,
+        marker-persisted: the supervisor-relaunched worker re-counts
+        served shards from 0 and must not re-fire the drill into a crash
+        loop). Fired BEFORE the shard's answer is sent, so the client
+        observes a dead connection mid-request — the exact failure the
+        retry-on-another-server path exists for."""
+        if (self.kill_at_shard == n_shards
+                and self._fire_once("kill_shard")):
+            log_event("chaos", f"injecting SIGKILL at shard {n_shards}")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_stall_shard(self, n_shards: int) -> None:
+        """Stall the n-th served shard by `stall_ms` before answering
+        (fire-once, marker-persisted): a deterministic slow-server
+        episode. With `stall_ms` ABOVE the client's request timeout
+        (default 30 s — size the knob accordingly) the client's read
+        times out and the shard is re-fetched from another server; below
+        it, the shard is merely answered slowly (a latency blip, no
+        retry exercised). The stalled server stays healthy and keeps
+        serving later shards either way."""
+        if (self.stall_at_shard == n_shards
+                and self._fire_once("stall_shard")):
+            log_event(
+                "chaos",
+                f"injecting {self.stall_ms} ms stall at shard {n_shards}",
+            )
+            time.sleep(self.stall_ms / 1e3)
 
     def maybe_wedge_request(self, n_requests: int) -> bool:
         """True once, at the n-th admitted request: the caller (the serve
@@ -311,6 +364,9 @@ _INT_FIELDS = (
     "loader_error_at_batch",
     "loader_error_count",
     "kill_at_request",
+    "kill_at_shard",
+    "stall_at_shard",
+    "stall_ms",
     "wedge_at_request",
     "collapse_at_step",
     "resize_at_step",

@@ -32,6 +32,13 @@ EXIT_FLEET_BIND = 48           # tools/serve_fleet.py could not bind the
                                # FRONT-END router port (the replica ports are
                                # the replicas' own 47s): same fatal semantics
                                # — rescheduling beats racing the socket
+EXIT_STAGING_BIND = 50         # tools/staging_server.py (or its decode
+                               # worker) could not bind its health/data
+                               # port: same fatal reschedule-don't-retry
+                               # semantics as the serve binds 47/48 — the
+                               # staging supervisor classifies a worker's 50
+                               # as fatal instead of burning its restart
+                               # budget racing the same socket
 EXIT_RESIZE = 49               # elastic resize honored (ISSUE 11): a clean
                                # checkpoint was written and the driver exited
                                # so the supervisor can relaunch it onto a
@@ -52,5 +59,6 @@ EXIT_CODE_NAMES: dict[int, str] = {
     EXIT_SERVE_BIND: "serve_bind",
     EXIT_FLEET_BIND: "fleet_bind",
     EXIT_RESIZE: "resize",
+    EXIT_STAGING_BIND: "staging_bind",
     USAGE_ERROR: "usage_error",
 }

@@ -55,6 +55,7 @@ from moco_tpu.resilience.exitcodes import (
     EXIT_RESIZE,
     EXIT_ROLLBACK_EXHAUSTED,
     EXIT_SERVE_BIND,
+    EXIT_STAGING_BIND,
     USAGE_ERROR,
 )
 from moco_tpu.resilience.resize import (
@@ -91,11 +92,17 @@ CLASS_FLEET_BIND = "fleet_bind"                # serve_fleet.py couldn't bind
 CLASS_RESIZE = "resize"                        # elastic checkpoint written;
                                                # relaunch onto the new mesh
                                                # (ISSUE 11)
+CLASS_STAGING_BIND = "staging_bind"            # staging_server.py (or its
+                                               # decode worker) couldn't bind
+                                               # its health/data port (ISSUE
+                                               # 14): reschedule, don't race
+                                               # the socket
 
 # classes where restarting can never help — the run is OVER
 FATAL_CLASSES = frozenset({
     CLASS_CLEAN, CLASS_ROLLBACK_EXHAUSTED, CLASS_CONFIG_ERROR,
     CLASS_DATA_QUALITY, CLASS_SERVE_BIND, CLASS_FLEET_BIND,
+    CLASS_STAGING_BIND,
 })
 RESTARTABLE_CLASSES = frozenset({
     CLASS_PREEMPTED, CLASS_HANG, CLASS_NATIVE_CRASH, CLASS_OOM,
@@ -235,6 +242,7 @@ def classify_exit(
         # orchestrator one level up must reschedule, not retry-loop
         EXIT_SERVE_BIND: CLASS_SERVE_BIND,
         EXIT_FLEET_BIND: CLASS_FLEET_BIND,
+        EXIT_STAGING_BIND: CLASS_STAGING_BIND,
         EXIT_RESIZE: CLASS_RESIZE,
         USAGE_ERROR: CLASS_CONFIG_ERROR,
     }

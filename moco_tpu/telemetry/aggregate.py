@@ -62,6 +62,12 @@ SCHEMA_VERSION = 1
 EVENTS_FILENAME = "events.jsonl"
 HEARTBEAT_FILENAME = "heartbeat.json"
 
+# telemetry subdirectory families a root can contain: `replica*` (the
+# serve-fleet layout, ISSUE 10) and `staging_server*` (the input-service
+# layout, ISSUE 14). THE definition — telemetry_report discovers the
+# same dirs obsd tails, so the next family lands in both at once
+TELEMETRY_SUBDIR_PREFIXES = ("replica", "staging_server")
+
 SLO_KIND = "slo"
 
 # event names that count as "rollback/NaN trouble" for the default rule
@@ -174,8 +180,10 @@ class StreamTailer:
 def discover_streams(roots) -> dict:
     """`{label: events_path}` for the given telemetry roots. A FILE
     argument is one stream; a DIRECTORY contributes its own events.jsonl
-    plus every `replica*/events.jsonl` under it (the fleet layout) —
-    called every poll, so replica dirs that appear later join live."""
+    plus every `replica*/events.jsonl` (the fleet layout) and
+    `staging_server*/events.jsonl` (the input-service layout, ISSUE 14)
+    under it — called every poll, so replica/server dirs that appear
+    later join live."""
     streams: dict[str, str] = {}
     for root in roots:
         if os.path.isfile(root) or root.endswith(".jsonl"):
@@ -189,7 +197,8 @@ def discover_streams(roots) -> dict:
             continue
         for name in names:
             sub = os.path.join(root, name, EVENTS_FILENAME)
-            if name.startswith("replica") and os.path.exists(sub):
+            if (name.startswith(TELEMETRY_SUBDIR_PREFIXES)
+                    and os.path.exists(sub)):
                 streams[os.path.join(root, name)] = sub
     return streams
 
@@ -228,6 +237,7 @@ class RunWindow:
         self._router: deque = deque(maxlen=256)       # (mono, record)
         self._serve: deque = deque(maxlen=256)        # (mono, record)
         self._health: deque = deque(maxlen=256)       # (mono, block, step)
+        self._input: deque = deque(maxlen=256)        # (mono, input snap)
         self.last_step: dict | None = None
         self.last_router: dict | None = None
         self.last_serve: dict | None = None
@@ -279,11 +289,26 @@ class RunWindow:
                 ))
                 if isinstance(health, dict):
                     self._health.append((now, health, step_no))
+                # cumulative input-pipeline snapshot (ISSUE 14): the
+                # credit_stall_s/wall_s pair feeds the windowed
+                # input_credit_stall_rate delta
+                if isinstance(rec.get("input"), dict):
+                    self._input.append((now, rec["input"]))
         elif kind == "event":
             name = str(rec.get("event", "unknown"))
             self.incidents[name] = self.incidents.get(name, 0) + 1
             if not historical:
                 self._events.append((now, name))
+        elif kind == "input_server":
+            # staging-server stream (ISSUE 14): periodic `stats` records
+            # are routine cumulative snapshots, lifecycle transitions
+            # (launch/eject/kill/worker_exit/give_up) are incidents like
+            # their fleet twins
+            name = str(rec.get("event", "unknown"))
+            if name != "stats":
+                self.incidents[name] = self.incidents.get(name, 0) + 1
+                if not historical:
+                    self._events.append((now, name))
         elif kind in ("supervisor", "fleet"):
             name = str(rec.get("event", "unknown"))
             if name == "router_stats":
@@ -353,6 +378,12 @@ class RunWindow:
           outstanding                   last router_stats outstanding depth
           router_latency_ms_p95         last router_stats window p95
           serve_latency_ms_p95          last serve snapshot p95
+          input_credit_stall_rate       input-snapshot delta (ISSUE 14):
+                                        credit_stall_s/wall_s — the
+                                        fraction of wall time the train
+                                        host spent blocked on an empty
+                                        ready queue; a sustained high
+                                        rate IS a starving train host
           reload_failures               reload_* failure events in window
           rollback_events               rollback/sentinel events in window
           resize_relaunches             resize_relaunch records in window
@@ -431,6 +462,15 @@ class RunWindow:
                 return None
             sheds, requests = delta
             return sheds / requests if requests else 0.0
+        if name == "input_credit_stall_rate":
+            delta = self._counter_delta(
+                self._input, window_s, now,
+                lambda r: (float(r.get("credit_stall_s", 0.0)),
+                           float(r.get("wall_s", 0.0))))
+            if delta is None:
+                return None
+            stalled, wall = delta
+            return stalled / wall if wall else 0.0
         if name == "outstanding":
             if self.last_router is None:
                 return None
@@ -511,6 +551,9 @@ DEFAULT_RULES = (
      "fast_window_s": 60.0, "slow_window_s": 300.0},
     {"name": "shed_rate", "objective": "shed_rate",
      "op": ">", "threshold": 0.05,
+     "fast_window_s": 60.0, "slow_window_s": 300.0},
+    {"name": "input_credit_stall", "objective": "input_credit_stall_rate",
+     "op": ">", "threshold": 0.25,
      "fast_window_s": 60.0, "slow_window_s": 300.0},
     {"name": "reload_failure", "objective": "reload_failures",
      "op": ">=", "threshold": 1.0,
@@ -912,6 +955,7 @@ class Aggregator:
             step_pcts, data_share, mfu, steps_tot, stale = [], [], [], [], []
             incidents, router_g, router_lat, serve_lat = [], [], [], []
             health_g: list = []
+            input_stall: list = []
             router_counters: dict[str, list] = {}
             for run_id, w in per_run:
                 lab = {"run_id": run_id}
@@ -928,6 +972,9 @@ class Aggregator:
                 v = w.metric("mfu_mean", 300.0, now)
                 if v is not None:
                     mfu.append((lab, v))
+                v = w.metric("input_credit_stall_rate", 300.0, now)
+                if v is not None:
+                    input_stall.append((lab, v))
                 if w.last_health:
                     for key in sorted(w.last_health):
                         v = w.metric(f"health:{key}", 300.0, now)
@@ -976,6 +1023,9 @@ class Aggregator:
         emit("moco_tpu_health", "gauge",
              "windowed (300s) mean learning-health diagnostics by key",
              health_g)
+        emit("moco_tpu_input_credit_stall_rate", "gauge",
+             "windowed (300s) fraction of wall time the train host spent "
+             "blocked on an empty input ready queue", input_stall)
         emit("moco_tpu_run_stale_seconds", "gauge",
              "seconds since the run's newest record was observed", stale)
         emit("moco_tpu_events_total", "counter",

@@ -297,6 +297,31 @@ def _train_once(config: PretrainConfig, mesh, max_steps: int | None = None,
             tel.close()
 
 
+def _service_dataset_len(endpoints_spec) -> int:
+    """Dataset length from the first staging server that answers a meta
+    probe. Every endpoint is tried once; total unreachability is a
+    configuration error (the servers are expected up before the train
+    host starts — same contract as ServiceClient's handshake). A
+    same-length-different-data server is still caught per-connection by
+    the client's meta check."""
+    from moco_tpu.data.service import protocol
+    from moco_tpu.data.service.client import ServiceConfigError
+
+    endpoints = (protocol.parse_endpoints(endpoints_spec)
+                 if isinstance(endpoints_spec, str) else endpoints_spec)
+    tried = []
+    for host, port in endpoints:
+        meta = protocol.fetch_meta(host, port)
+        if meta is not None and int(meta.get("n", 0)) > 0:
+            return int(meta["n"])
+        tried.append(f"{host}:{port}")
+    raise ServiceConfigError(
+        "no staging server answered a meta probe (tried "
+        + ", ".join(tried)
+        + ") — start the servers first, or unset input_service"
+    )
+
+
 def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                      dataset=None, data_advance: int = 0,
                      poison_pos: tuple[int, int] | None = None,
@@ -318,20 +343,39 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
     n_chips = mesh.size
     local_b = local_batch_size(config.batch_size, mesh)  # validates divisibility
 
+    dataset_len = None
     if dataset is None:
-        dataset = build_dataset(
-            config.dataset, config.data_dir, image_size=config.image_size,
-            stage_size=config.stage_size, num_workers=config.num_workers,
-        )
+        if config.input_prestage:
+            # pre-staged epoch cache (ISSUE 14): the dataset IS the mmap —
+            # epochs are row gathers, decode happened once offline
+            from moco_tpu.data.service.prestage import PrestagedDataset
+
+            dataset = PrestagedDataset(config.input_prestage)
+        elif config.input_service and not config.knn_monitor:
+            # input_service is the remote-decode topology: the train host
+            # may not even mount the data tree, and the only local use of
+            # the dataset would be len(). The handshake meta already
+            # carries the length every ServiceClient connection validates
+            # against — probe it instead of paying an ImageFolder scan.
+            # (The kNN monitor genuinely decodes locally, so it keeps the
+            # local build.)
+            dataset_len = _service_dataset_len(config.input_service)
+        else:
+            dataset = build_dataset(
+                config.dataset, config.data_dir, image_size=config.image_size,
+                stage_size=config.stage_size, num_workers=config.num_workers,
+            )
+    if dataset_len is None:
+        dataset_len = len(dataset)
     # clamp to the batches the loader can actually yield: a steps_per_epoch
     # above that silently truncated epochs (and stretched the lr schedule) —
     # the r2 "3200-step" horizon run actually ran 768 steps this way
-    available = max(len(dataset) // config.batch_size, 1)
+    available = max(dataset_len // config.batch_size, 1)
     steps_per_epoch = min(config.steps_per_epoch or available, available)
     if config.steps_per_epoch and steps_per_epoch < config.steps_per_epoch:
         info(
             f"steps_per_epoch clamped {config.steps_per_epoch} -> "
-            f"{steps_per_epoch}: the {len(dataset)}-sample dataset yields only "
+            f"{steps_per_epoch}: the {dataset_len}-sample dataset yields only "
             f"{available} batches of {config.batch_size}"
         )
 
@@ -357,11 +401,18 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
             _telemetry_out.append(telemetry)
     input_stats = telemetry.input_stats if telemetry is not None else None
 
-    if config.input_cache_mb:
+    if (config.input_cache_mb and not config.input_prestage
+            and dataset is not None):
         # decode-once canvas cache (ISSUE 3): wrapped per driver pass, so a
         # NaN rollback restarts it cold (safe — it is index-keyed, carries
         # no positional state, and the skipped window is simply never asked
         # for). Lives OUTSIDE the epoch loop: epochs >= 2 are the payoff.
+        # (A prestage is already the cache-everything case — wrapping it
+        # would spend RAM duplicating an mmap the page cache shares. The
+        # guard is "a local decoding dataset exists": a service-fed run
+        # without the kNN monitor built none, while service + kNN keeps
+        # one whose repeated bank encodes are exactly this cache's
+        # workload.)
         from moco_tpu.data.canvas_cache import CachedDataset
 
         dataset = CachedDataset(dataset, config.input_cache_mb,
@@ -594,14 +645,35 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                 skip = steps_per_epoch if epoch < poison_epoch else max(
                     skip, poison_batch + 1)
             epoch_start_step = global_step
-            loader = epoch_loader(
-                dataset, epoch, config.seed, config.batch_size, mesh,
-                skip_batches=skip, retries=config.loader_retries,
-                backoff_secs=config.loader_backoff_secs,
-                depth=config.prefetch_depth, workers=config.staging_workers,
-                stats=input_stats, trim_h2d=config.h2d_trim,
-                tracer=telemetry.tracer if telemetry is not None else None,
-            )
+            if config.input_service:
+                # disaggregated input service (ISSUE 14): the SAME epoch
+                # permutation/shard/fast-forward, but canvas rows stream
+                # from standalone staging servers — bit-identical to the
+                # in-process branch below on the same seed/epoch
+                from moco_tpu.data.service.client import service_epoch_loader
+
+                loader = service_epoch_loader(
+                    config.input_service, dataset_len, epoch, config.seed,
+                    config.batch_size, mesh, skip_batches=skip,
+                    retries=config.loader_retries,
+                    backoff_secs=config.loader_backoff_secs,
+                    depth=config.prefetch_depth,
+                    streams=config.staging_workers, stats=input_stats,
+                    tracer=telemetry.tracer if telemetry is not None
+                    else None,
+                    request_timeout_s=config.input_request_timeout_s,
+                )
+            else:
+                loader = epoch_loader(
+                    dataset, epoch, config.seed, config.batch_size, mesh,
+                    skip_batches=skip, retries=config.loader_retries,
+                    backoff_secs=config.loader_backoff_secs,
+                    depth=config.prefetch_depth,
+                    workers=config.staging_workers,
+                    stats=input_stats, trim_h2d=config.h2d_trim,
+                    tracer=telemetry.tracer if telemetry is not None
+                    else None,
+                )
             end = time.perf_counter()
             if telemetry is not None:
                 telemetry.timer.epoch_start()

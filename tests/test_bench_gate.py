@@ -53,6 +53,38 @@ def test_flatten_wrapper_with_nested_folds():
     assert details == 2  # noted, never gated
 
 
+def test_flatten_service_and_prestage_rows_gate_detail_excluded():
+    """ISSUE 14: the e2e child's service/prestage rows gate under their
+    own metric names; noisy per-server detail rows are counted, never
+    gated — the per-thread-row rule."""
+    svc = {"metric": "m_e2e_service", "value": 60.0, "servers": 2,
+           "detail": {"server0_shards": 8, "server1_shards": 8,
+                      "server0_shard_s_p95": 0.01}}
+    pre = {"metric": "m_e2e_prestage", "value": 90.0,
+           "vs_device_bound": 0.95}
+    # shape 1: the orchestrator nests the child's record under "e2e"
+    rec = {"metric": "m_step", "value": 100.0,
+           "e2e": {"metric": "m_e2e", "value": 50.0,
+                   "service": svc, "prestage": pre}}
+    flat, details = flatten(_wrapper(parsed=rec, tail_records=[rec]))
+    assert flat == {"m_step": 100.0, "m_e2e": 50.0,
+                    "m_e2e_service": 60.0, "m_e2e_prestage": 90.0}
+    assert details == 3  # the per-server rows, noted but not gated
+    # shape 2: the e2e CHILD's own stdout record carries them top-level
+    child = {"metric": "m_e2e", "value": 50.0,
+             "service": svc, "prestage": pre}
+    flat, details = flatten(_wrapper(parsed=child, tail_records=[child]))
+    assert flat == {"m_e2e": 50.0, "m_e2e_service": 60.0,
+                    "m_e2e_prestage": 90.0}
+    assert details == 3
+    # a dead pool degrades to an error row — no value, no gate, no crash
+    rec = {"metric": "m_e2e", "value": 50.0,
+           "service": {"metric": "m_e2e_service",
+                       "error": "RuntimeError: pool never healthy"}}
+    flat, _ = flatten(_wrapper(parsed=rec, tail_records=[rec]))
+    assert flat == {"m_e2e": 50.0}
+
+
 def test_flatten_takes_last_record_per_metric_and_skips_garbage():
     text = "\n".join([
         "not json",

@@ -1,0 +1,979 @@
+"""Disaggregated input service (ISSUE 14).
+
+  - protocol: frame round-trips, bounds/garbage rejection, endpoint
+    parsing, structured error surfacing
+  - prestage: decode-once mmap format round-trips bit-identical; every
+    incomplete/drifted directory is refused loudly
+  - ServiceClient vs in-process Prefetcher: BIT-IDENTICAL batches on the
+    same seed/epoch (the ISSUE acceptance pin), including when the rows
+    come from a pre-staged epoch cache
+  - failure contract: retry-on-another-server for dead peers, immediate
+    surfacing of non-retryable remote errors, loud config-drift refusal
+  - chaos: kill_at_shard / stall_at_shard knobs parse and fire once
+  - resilience plumbing: EXIT_STAGING_BIND from both CLI halves,
+    classify_exit -> CLASS_STAGING_BIND (fatal: reschedule, don't race)
+  - telemetry: per-server stats fold into telemetry_report; the obsd
+    input_credit_stall_rate objective; cross-process serve_shard spans
+    continue the coordinator's stage_batch trace
+  - THE tier-1 drill: SIGKILL one of two real staging servers mid-epoch
+    -> every shard re-lands on the survivor, the epoch is bit-identical,
+    zero lost batches, and the supervisor relaunches the dead worker
+
+Fast tests run DecodeWorker in-thread (real sockets, no subprocess);
+only the drill and the slow soak spawn real server processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from moco_tpu.config import PretrainConfig
+from moco_tpu.data.datasets import SyntheticDataset
+from moco_tpu.data.loader import epoch_loader
+from moco_tpu.data.service import protocol
+from moco_tpu.data.service.client import (
+    ServiceClient,
+    ServiceConfigError,
+    service_epoch_loader,
+)
+from moco_tpu.data.service.fleet import LocalServerPool
+from moco_tpu.data.service.prestage import (
+    PrestageError,
+    PrestagedDataset,
+    write_prestage,
+)
+from moco_tpu.data.service.worker import DecodeWorker
+from moco_tpu.data.service.worker import main as worker_main
+from moco_tpu.data.stats import InputPipelineStats
+from moco_tpu.resilience.chaos import ChaosPlan, parse_chaos_spec
+from moco_tpu.resilience.exitcodes import (
+    EXIT_CODE_NAMES,
+    EXIT_STAGING_BIND,
+)
+from moco_tpu.resilience.supervisor import (
+    CLASS_STAGING_BIND,
+    FATAL_CLASSES,
+    classify_exit,
+)
+
+N_SAMPLES = 64
+GLOBAL_BATCH = 16  # 8 fake devices x 2 rows; 4 batches per epoch
+
+
+def _dataset(**kw):
+    kw.setdefault("num_samples", N_SAMPLES)
+    kw.setdefault("image_size", 32)
+    kw.setdefault("seed", 0)
+    return SyntheticDataset(**kw)
+
+
+def _start_worker(dataset, **kw):
+    """One in-thread DecodeWorker on an auto port (real sockets, real
+    protocol, no subprocess)."""
+    worker = DecodeWorker(dataset, "127.0.0.1", 0, **kw)
+    t = threading.Thread(target=worker.serve_forever, daemon=True,
+                         name="test-worker")
+    t.start()
+    return worker
+
+
+def _drain(loader):
+    """[(imgs, labels, extents) as numpy] for every yielded batch."""
+    return [(np.asarray(i), np.asarray(l), np.asarray(e))
+            for i, l, e in loader]
+
+
+def _assert_batches_equal(got, want):
+    assert len(got) == len(want)
+    for (gi, gl, ge), (wi, wl, we) in zip(got, want):
+        np.testing.assert_array_equal(gi, wi)
+        np.testing.assert_array_equal(gl, wl)
+        np.testing.assert_array_equal(ge, we)
+
+
+def _reference_epoch(mesh8, epoch=1, dataset=None):
+    loader = epoch_loader(dataset if dataset is not None else _dataset(),
+                          epoch, 0, GLOBAL_BATCH, mesh8, workers=2)
+    try:
+        return _drain(loader)
+    finally:
+        loader.close_quietly()
+
+
+# ---------------------------------------------------------------------------
+# protocol
+# ---------------------------------------------------------------------------
+
+
+def test_frame_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        payload = np.arange(16, dtype="<i8").tobytes()
+        protocol.send_frame(a, {"op": "shard", "batch": 3}, payload)
+        header, got = protocol.recv_frame(b)
+        assert header == {"op": "shard", "batch": 3}
+        assert got == payload
+        protocol.send_frame(b, {"op": "pong", "stats": {}})  # empty payload
+        header, got = protocol.recv_frame(a)
+        assert header["op"] == "pong" and got == b""
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_bounds_and_garbage_rejected():
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(protocol.FrameError, match="bounds"):
+            protocol.send_frame(
+                a, {"op": "x"}, b"\0" * (protocol.MAX_PAYLOAD_BYTES + 1))
+        # a foreign/corrupt prefix must refuse, not allocate gigabytes
+        a.sendall(b"\xff\xff\xff\xff\xff\xff\xff\xff")
+        with pytest.raises(protocol.FrameError, match="not this protocol"):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    # a peer hanging up mid-frame is a ConnectionError (retry food)
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x00\x00\x00\x08")  # half a prefix, then gone
+        a.close()
+        with pytest.raises(ConnectionError):
+            protocol.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_parse_endpoints_forms_and_errors():
+    assert protocol.parse_endpoints("h1:1, h2:2;h3:3,") == [
+        ("h1", 1), ("h2", 2), ("h3", 3)]
+    with pytest.raises(ValueError, match="not host:port"):
+        protocol.parse_endpoints("just-a-host")
+    with pytest.raises(ValueError, match="non-integer port"):
+        protocol.parse_endpoints("h:eighty")
+    with pytest.raises(ValueError, match="no endpoints"):
+        protocol.parse_endpoints(" , ")
+
+
+def test_raise_if_error_surfaces_remote_shard_error():
+    with pytest.raises(protocol.RemoteShardError) as exc:
+        protocol.raise_if_error({"op": "error", "code": "transient",
+                                 "detail": "flaky read",
+                                 "retryable": True})
+    assert exc.value.retryable and exc.value.code == "transient"
+    protocol.raise_if_error({"op": "data"})  # not an error: no raise
+
+
+# ---------------------------------------------------------------------------
+# prestage format
+# ---------------------------------------------------------------------------
+
+
+def test_prestage_roundtrip_bit_identical(tmp_path):
+    ds = _dataset()
+    root = str(tmp_path / "pre")
+    meta = write_prestage(ds, root, chunk=10)
+    assert meta["n"] == N_SAMPLES
+    pre = PrestagedDataset(root)
+    assert len(pre) == N_SAMPLES
+    idx = np.asarray([0, 5, 63, 7])
+    want_i, want_l, want_e = ds.get_batch(idx)
+    got_i, got_l, got_e = pre.get_batch(idx)
+    np.testing.assert_array_equal(got_i, want_i)
+    np.testing.assert_array_equal(got_l, want_l)
+    np.testing.assert_array_equal(got_e, want_e)
+    # the staging-canvas protocol: memcpy into caller-owned rows
+    out_i = np.zeros_like(want_i)
+    out_e = np.zeros_like(want_e)
+    labels = pre.get_batch_into(idx, out_i, out_e)
+    np.testing.assert_array_equal(out_i, want_i)
+    np.testing.assert_array_equal(out_e, want_e)
+    np.testing.assert_array_equal(np.asarray(labels), want_l)
+
+
+def test_prestage_refuses_incomplete_and_drifted(tmp_path):
+    ds = _dataset(num_samples=8)
+    root = str(tmp_path / "pre")
+    write_prestage(ds, root)
+    # never silently overwrite a whole-cluster artifact
+    with pytest.raises(PrestageError, match="already holds"):
+        write_prestage(ds, root)
+    # missing meta == killed writer == not a prestage
+    incomplete = str(tmp_path / "torn")
+    os.makedirs(incomplete)
+    with pytest.raises(PrestageError, match="no meta.json"):
+        PrestagedDataset(incomplete)
+    # meta/payload drift is refused loudly
+    meta_path = os.path.join(root, "meta.json")
+    with open(meta_path, encoding="utf-8") as f:
+        meta = json.load(f)
+    meta["n"] = 9
+    with open(meta_path, "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    with pytest.raises(PrestageError, match="disagrees with meta"):
+        PrestagedDataset(root)
+    # a future format version is refused, not misread
+    meta["n"] = 8
+    meta["v"] = 999
+    with open(meta_path, "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    with pytest.raises(PrestageError, match="v999"):
+        PrestagedDataset(root)
+
+
+# ---------------------------------------------------------------------------
+# ServiceClient vs in-process Prefetcher: THE bit-identity pin
+# ---------------------------------------------------------------------------
+
+
+def test_service_bit_identical_to_inprocess(mesh8):
+    """Two in-thread staging servers, same dataset code: every service-fed
+    batch equals the in-process Prefetcher batch bit-for-bit, same order,
+    none lost."""
+    want = _reference_epoch(mesh8)
+    w1 = _start_worker(_dataset())
+    w2 = _start_worker(_dataset())
+    client = None
+    try:
+        client = service_epoch_loader(
+            [(w1.host, w1.port), (w2.host, w2.port)], N_SAMPLES, 1, 0,
+            GLOBAL_BATCH, mesh8, streams=2, backoff_secs=0.05)
+        got = _drain(client)
+    finally:
+        if client is not None:
+            client.close_quietly()
+        w1.stop(timeout_s=1.0)
+        w2.stop(timeout_s=1.0)
+    _assert_batches_equal(got, want)
+    # both servers actually served (streams round-robin the endpoints)
+    assert w1.stats.shards + w2.stats.shards >= 4
+
+
+def test_service_bit_identical_from_prestage(mesh8, tmp_path):
+    """The degenerate cache-everything case: a server answering from the
+    pre-staged epoch cache yields the same bits as in-process decode."""
+    want = _reference_epoch(mesh8)
+    root = str(tmp_path / "pre")
+    write_prestage(_dataset(), root)
+    worker = _start_worker(PrestagedDataset(root), prestaged=True)
+    client = None
+    try:
+        client = service_epoch_loader(
+            f"{worker.host}:{worker.port}", N_SAMPLES, 1, 0,
+            GLOBAL_BATCH, mesh8, streams=2)
+        assert client.meta["prestaged"] is True
+        got = _drain(client)
+    finally:
+        if client is not None:
+            client.close_quietly()
+        worker.stop(timeout_s=1.0)
+    _assert_batches_equal(got, want)
+
+
+def test_chunked_shards_bit_identical(mesh8):
+    """The frame payload bound means a big per-host batch must split
+    into multiple shard requests (client.MAX_SHARD_ROWS math); pin that
+    a tiny forced cap — every fetch chunked, including the whole-batch
+    shape-discovery path — still yields bit-identical epochs."""
+    from moco_tpu.data.loader import epoch_permutation, host_shard
+
+    want = _reference_epoch(mesh8)
+    indices = host_shard(epoch_permutation(N_SAMPLES, 1, 0, GLOBAL_BATCH),
+                         GLOBAL_BATCH)
+    worker = _start_worker(_dataset())
+    client = None
+    try:
+        client = ServiceClient(
+            [(worker.host, worker.port)], indices, GLOBAL_BATCH, mesh8,
+            streams=2, max_shard_rows=3)
+        got = _drain(client)
+    finally:
+        if client is not None:
+            client.close_quietly()
+        worker.stop(timeout_s=1.0)
+    _assert_batches_equal(got, want)
+    # the cap really forced chunking: 4 batches x 16 rows / <=3 rows
+    assert worker.stats.shards >= 4 * 6
+
+
+def test_inprocess_prefetcher_over_prestage_bit_identical(mesh8, tmp_path):
+    """The OTHER prestage consumer: the plain Prefetcher pointed at the
+    mmap (config.input_prestage) matches fresh decode bit-for-bit."""
+    want = _reference_epoch(mesh8)
+    root = str(tmp_path / "pre")
+    write_prestage(_dataset(), root)
+    got = _reference_epoch(mesh8, dataset=PrestagedDataset(root))
+    _assert_batches_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# failure contract
+# ---------------------------------------------------------------------------
+
+
+def test_client_retries_shards_on_another_server(mesh8):
+    """One endpoint is a peer that accepts and instantly hangs up: every
+    shard it was offered re-lands on the healthy server, the epoch stays
+    bit-identical and complete."""
+    want = _reference_epoch(mesh8)
+    stop = threading.Event()
+    refuser = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    refuser.bind(("127.0.0.1", 0))
+    refuser.listen(8)
+    refuser.settimeout(0.1)
+    dead_port = refuser.getsockname()[1]
+
+    def _refuse():
+        while not stop.is_set():
+            try:
+                conn, _ = refuser.accept()
+                conn.close()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+
+    t = threading.Thread(target=_refuse, daemon=True)
+    t.start()
+    worker = _start_worker(_dataset())
+    client = None
+    try:
+        client = service_epoch_loader(
+            [("127.0.0.1", dead_port), (worker.host, worker.port)],
+            N_SAMPLES, 1, 0, GLOBAL_BATCH, mesh8, streams=2,
+            backoff_secs=0.05)
+        got = _drain(client)
+    finally:
+        if client is not None:
+            client.close_quietly()
+        worker.stop(timeout_s=1.0)
+        stop.set()
+        refuser.close()
+    _assert_batches_equal(got, want)
+    assert worker.stats.shards >= 4  # the survivor carried the epoch
+
+
+def test_client_surfaces_nonretryable_error_immediately(mesh8):
+    """A non-retryable remote error must NOT burn the retry budget — it
+    is a programming/config error, surfaced as-is."""
+    stop = threading.Event()
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    lsock.settimeout(0.1)
+    port = lsock.getsockname()[1]
+    meta = {"op": protocol.OP_META, "n": N_SAMPLES,
+            "img_shape": [32, 32, 3], "img_dtype": "uint8",
+            "label_dtype": "int32", "server_id": 7}
+
+    def _serve_one(conn):
+        # one thread per connection: the client's handshake link stays
+        # open (and silent) while its fetch thread opens another
+        try:
+            conn.settimeout(10.0)
+            header, _ = protocol.recv_frame(conn)
+            if header.get("op") == protocol.OP_HELLO:
+                protocol.send_frame(conn, meta)
+                header, _ = protocol.recv_frame(conn)
+            if header.get("op") == protocol.OP_SHARD:
+                protocol.send_frame(conn, {
+                    "op": protocol.OP_ERROR,
+                    "code": protocol.ERR_BAD_REQUEST,
+                    "detail": "dataset drift", "retryable": False})
+        except (ConnectionError, protocol.FrameError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _serve():
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=_serve_one, args=(conn,),
+                             daemon=True).start()
+
+    t = threading.Thread(target=_serve, daemon=True)
+    t.start()
+    client = None
+    try:
+        client = ServiceClient(
+            [("127.0.0.1", port)], np.arange(GLOBAL_BATCH), GLOBAL_BATCH,
+            # retries=50: proof the non-retryable error skips the budget
+            mesh8, retries=50, backoff_secs=0.01, streams=1)
+        with pytest.raises(protocol.RemoteShardError, match="drift"):
+            _drain(client)
+    finally:
+        if client is not None:
+            client.close_quietly()
+        stop.set()
+        lsock.close()
+
+
+def test_worker_answers_error_on_garbage_shard_payload():
+    """A shard payload that is not a whole number of <i8 indices answers
+    a non-retryable bad_request ERROR frame — and the connection thread
+    survives to serve the next (well-formed) request."""
+    worker = _start_worker(_dataset())
+    try:
+        with socket.create_connection((worker.host, worker.port),
+                                      timeout=5.0) as sock:
+            sock.settimeout(5.0)
+            protocol.send_frame(sock, {"op": protocol.OP_HELLO,
+                                       "role": "client",
+                                       "proto": protocol.PROTO_VERSION})
+            protocol.recv_frame(sock)  # meta
+            protocol.send_frame(sock, {"op": protocol.OP_SHARD,
+                                       "batch": 0, "lo": 0, "hi": 1},
+                                b"1234567")
+            header, _ = protocol.recv_frame(sock)
+            assert header["op"] == protocol.OP_ERROR
+            assert header["code"] == protocol.ERR_BAD_REQUEST
+            assert header["retryable"] is False
+            idx = np.zeros(1, dtype="<i8").tobytes()
+            protocol.send_frame(sock, {"op": protocol.OP_SHARD,
+                                       "batch": 0, "lo": 0, "hi": 1},
+                                idx)
+            header, _ = protocol.recv_frame(sock)
+            assert header["op"] == protocol.OP_DATA
+    finally:
+        worker.stop(timeout_s=1.0)
+
+
+def test_client_retries_malformed_data_answer_on_another_server(mesh8):
+    """A data answer with garbage/missing shapes is a peer speaking
+    garbage — the SAME retry-on-another-server class as a torn frame
+    (FrameError), not a run-killing KeyError: the epoch completes
+    bit-identically off the healthy server."""
+    worker = _start_worker(_dataset())
+    stop = threading.Event()
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    lsock.settimeout(0.1)
+    bad_port = lsock.getsockname()[1]
+    meta = {"op": protocol.OP_META, "n": N_SAMPLES,
+            "img_shape": [32, 32, 3], "img_dtype": "uint8",
+            "label_dtype": "int32", "server_id": 9}
+
+    def _serve_one(conn):
+        try:
+            conn.settimeout(10.0)
+            while True:
+                header, _ = protocol.recv_frame(conn)
+                if header.get("op") == protocol.OP_HELLO:
+                    protocol.send_frame(conn, meta)
+                elif header.get("op") == protocol.OP_SHARD:
+                    # well-framed, wrong content: no shapes/dtypes keys
+                    protocol.send_frame(conn, {"op": protocol.OP_DATA},
+                                        b"")
+                else:
+                    return
+        except (ConnectionError, protocol.FrameError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _serve():
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=_serve_one, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=_serve, daemon=True).start()
+    client = None
+    try:
+        client = service_epoch_loader(
+            f"127.0.0.1:{bad_port},{worker.host}:{worker.port}",
+            N_SAMPLES, 1, 0, GLOBAL_BATCH, mesh8, streams=2,
+            backoff_secs=0.01)
+        got = _drain(client)
+    finally:
+        if client is not None:
+            client.close_quietly()
+        stop.set()
+        lsock.close()
+        worker.stop(timeout_s=1.0)
+    _assert_batches_equal(got, _reference_epoch(mesh8))
+
+
+def test_client_refuses_unreachable_and_drifted_config(mesh8):
+    # nothing listening: a configuration error, not a silent stall
+    probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()  # bound-then-closed: connection refused
+    client = None
+    try:
+        with pytest.raises(ServiceConfigError, match="no staging server"):
+            client = ServiceClient(
+                [("127.0.0.1", dead_port)], np.arange(GLOBAL_BATCH),
+                GLOBAL_BATCH, mesh8, connect_timeout_s=0.5)
+    finally:
+        if client is not None:  # ctor raised: nothing to close
+            client.close_quietly()
+    # a server whose dataset length disagrees with the run's is refused
+    worker = _start_worker(_dataset(num_samples=32))
+    try:
+        with pytest.raises(ServiceConfigError, match="32 samples"):
+            client = ServiceClient(
+                [(worker.host, worker.port)], np.arange(GLOBAL_BATCH),
+                GLOBAL_BATCH, mesh8, expected_len=N_SAMPLES)
+    finally:
+        if client is not None:
+            client.close_quietly()
+        worker.stop(timeout_s=1.0)
+    # EVERY server is validated, not just the first reachable one: a
+    # same-length server with drifted canvas geometry is refused the
+    # moment a fetch thread connects to it — never silently-wrong rows
+    w_a = _start_worker(_dataset())
+    w_b = _start_worker(_dataset(image_size=16))  # same n, 16x16 canvas
+    client = None
+    try:
+        with pytest.raises(ServiceConfigError, match="disagrees on"):
+            client = service_epoch_loader(
+                [(w_a.host, w_a.port), (w_b.host, w_b.port)], N_SAMPLES,
+                1, 0, GLOBAL_BATCH, mesh8, streams=2)
+            _drain(client)
+    finally:
+        if client is not None:
+            client.close_quietly()
+        w_a.stop(timeout_s=1.0)
+        w_b.stop(timeout_s=1.0)
+
+
+def test_config_knob_validation():
+    with pytest.raises(ValueError, match="not host:port"):
+        PretrainConfig(input_service="garbage")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        PretrainConfig(input_service="127.0.0.1:4000", h2d_trim=True)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        PretrainConfig(input_service="127.0.0.1:4000",
+                       input_prestage="/some/prestage")
+    with pytest.raises(ValueError, match="input_request_timeout_s"):
+        PretrainConfig(input_request_timeout_s=0)
+    # valid spec + the in-process default both construct fine
+    assert PretrainConfig(input_service="h1:4000,h2:4000").input_service
+    assert PretrainConfig().input_service == ""
+
+
+# ---------------------------------------------------------------------------
+# chaos knobs
+# ---------------------------------------------------------------------------
+
+
+def test_client_retries_injected_transient_faults(mesh8):
+    """The PR 1 contract on the CLIENT side: a chaos-injected
+    TransientDataError inside _fetch_rows re-enters the retry budget —
+    the service twin of test_prefetcher_retries_transient_reads."""
+    from moco_tpu.resilience.chaos import chaos_context
+
+    want = _reference_epoch(mesh8)
+    worker = _start_worker(_dataset())
+    client = None
+    try:
+        with chaos_context(ChaosPlan(loader_error_at_batch=1,
+                                     loader_error_count=2)):
+            client = service_epoch_loader(
+                f"{worker.host}:{worker.port}", N_SAMPLES, 1, 0,
+                GLOBAL_BATCH, mesh8, streams=2, retries=3,
+                backoff_secs=0.01)
+            got = _drain(client)
+    finally:
+        if client is not None:
+            client.close_quietly()
+        worker.stop(timeout_s=1.0)
+    _assert_batches_equal(got, want)
+
+
+def test_chaos_shard_knobs_parse_and_stall_fires_once(tmp_path):
+    plan = parse_chaos_spec("kill_at_shard=3,stall_at_shard=2,stall_ms=40")
+    assert plan.kill_at_shard == 3 and plan.stall_at_shard == 2
+    plan.state_dir = str(tmp_path)
+    t0 = time.perf_counter()
+    plan.maybe_stall_shard(2)
+    assert time.perf_counter() - t0 >= 0.04  # it really stalled
+    assert os.path.exists(tmp_path / "fired_stall_shard")
+    # fire-once ACROSS processes: a fresh plan sharing the state dir
+    # (the supervisor-relaunched worker) must not re-fire
+    relaunched = ChaosPlan(stall_at_shard=2, stall_ms=40,
+                           state_dir=str(tmp_path))
+    t0 = time.perf_counter()
+    relaunched.maybe_stall_shard(2)
+    assert time.perf_counter() - t0 < 0.04
+
+
+# ---------------------------------------------------------------------------
+# resilience plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_worker_bind_failure_exits_staging_bind(tmp_path):
+    blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        rc = worker_main(["--dataset", "synthetic", "--num-samples", "8",
+                          "--image-size", "16", "--port", str(port)])
+    finally:
+        blocker.close()
+    assert rc == EXIT_STAGING_BIND
+
+
+def test_worker_misconfigured_data_dir_exits_config_error(tmp_path):
+    """--data-dir at a file (NotADirectoryError — an OSError that is NOT
+    FileNotFoundError) is a config-class death: EXIT_CONFIG_ERROR, so
+    the supervisor abandons instead of relaunch-looping the budget."""
+    from moco_tpu.resilience.exitcodes import EXIT_CONFIG_ERROR
+
+    not_a_dir = tmp_path / "data"
+    not_a_dir.write_text("not a directory")
+    rc = worker_main(["--dataset", "imagefolder",
+                      "--data-dir", str(not_a_dir / "train")])
+    assert rc == EXIT_CONFIG_ERROR
+
+
+def test_staging_server_cli_health_bind_failure(tmp_path):
+    from tools.staging_server import main as cli_main
+
+    blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        # health binds FIRST in the supervisor ctor: the CLI fails with
+        # EXIT_STAGING_BIND before any worker subprocess exists
+        rc = cli_main(["--health-port", str(port), "--telemetry-dir",
+                       str(tmp_path), "--dataset", "synthetic"])
+    finally:
+        blocker.close()
+    assert rc == EXIT_STAGING_BIND
+
+
+def test_probe_decode_fault_is_not_a_bind_failure():
+    """A transient read fault on the row-0 meta probe must NOT exit
+    EXIT_STAGING_BIND — that class is fatal (the supervisor abandons);
+    a storage blip has to surface as a plain restartable crash."""
+    from moco_tpu.data.service.worker import ProbeDecodeError
+
+    class _FlakyProbe:
+        def __len__(self):
+            return 8
+
+        def get_batch(self, indices):
+            raise OSError("EIO: storage blip")
+
+    with pytest.raises(ProbeDecodeError):
+        DecodeWorker(_FlakyProbe(), "127.0.0.1", 0)
+
+
+def test_staging_bind_classification_is_fatal():
+    cls, _detail = classify_exit(EXIT_STAGING_BIND)
+    assert cls == CLASS_STAGING_BIND
+    assert CLASS_STAGING_BIND in FATAL_CLASSES  # reschedule, don't race
+    assert EXIT_CODE_NAMES[EXIT_STAGING_BIND] == "staging_bind"
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_worker_stats_and_credit_stall_accounting():
+    stats = InputPipelineStats()
+    stats.note_workers(2)
+    stats.note_credit_stall(0.5)
+    stats.note_credit_stall(0.25)
+    time.sleep(0.002)  # wall_s rounds to ms: give it one tick
+    snap = stats.snapshot()
+    assert snap["credit_stall_s"] == 0.75
+    assert snap["wall_s"] > 0
+
+
+def test_obsd_input_credit_stall_rate_objective():
+    from moco_tpu.telemetry.aggregate import RunWindow
+
+    w = RunWindow("r1")
+    for i, (stall, wall) in enumerate([(0.0, 10.0), (2.0, 20.0)]):
+        w.ingest({"kind": "step", "step": i, "step_s": 0.1,
+                  "input": {"credit_stall_s": stall, "wall_s": wall}},
+                 "src", "p", now=100.0 + i)
+    # delta: 2.0 s stalled over 10.0 s of wall
+    assert w.metric("input_credit_stall_rate", 60.0, 102.0) == \
+        pytest.approx(0.2)
+
+
+def test_report_folds_staging_server_dirs(tmp_path):
+    from tools.telemetry_report import (
+        expand_events_arg,
+        render,
+        summarize,
+    )
+
+    sdir = tmp_path / "staging_server0"
+    sdir.mkdir()
+    records = [
+        {"v": 1, "t": 1.0, "kind": "input_server", "event": "launch",
+         "server_id": 0, "pid": 123},
+        {"v": 1, "t": 2.0, "kind": "input_server", "event": "stats",
+         "server_id": 0, "shards": 40, "streamed_mb": 128.5,
+         "shard_s_p50": 0.004, "shard_s_p95": 0.011, "decode_s": 1.5,
+         "credit_stall_s": 3.0, "wall_s": 60.0, "errors": 1,
+         "connections": 2, "cache_hit_rate": 0.75},
+        {"v": 1, "t": 3.0, "kind": "input_server", "event": "worker_exit",
+         "server_id": 0, "returncode": -9,
+         "classification": "native_crash"},
+    ]
+    with open(sdir / "events.jsonl", "w", encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    pairs = expand_events_arg(str(tmp_path))
+    assert [label for label, _ in pairs] == ["staging_server0"]
+    summary = summarize(records)
+    isv = summary["input_servers"]
+    assert isv["n_servers"] == 1
+    assert isv["totals"] == {"shards": 40, "streamed_mb": 128.5,
+                             "errors": 1}
+    server = isv["servers"]["0"]
+    assert server["stats"]["cache_hit_rate"] == 0.75
+    assert server["events"] == {"launch": 1, "worker_exit": 1}
+    assert server["death_classes"] == ["native_crash"]
+    text = render(summary)
+    assert "input service: 1 staging server(s)" in text
+    assert "cache 75.0% hit" in text
+
+
+def test_report_sums_stats_across_worker_lives():
+    """A decode-worker relaunch restarts WorkerStats from zero; the
+    report detects the counter reset and SUMS additive counters across
+    lives — the kill-drill report must still count every shard the
+    pre-kill life served. Latency window / hit rate stay the last
+    life's (percentiles don't merge)."""
+    from tools.telemetry_report import summarize
+
+    records = [
+        {"v": 1, "kind": "input_server", "event": "stats", "server_id": 0,
+         "shards": 5, "streamed_mb": 10.0, "wall_s": 30.0, "errors": 1,
+         "shard_s_p50": 0.01, "credit_stall_s": 2.0, "decode_s": 1.0},
+        {"v": 1, "kind": "input_server", "event": "worker_exit",
+         "server_id": 0, "returncode": -9,
+         "classification": "native_crash"},
+        {"v": 1, "kind": "input_server", "event": "launch", "server_id": 0},
+        {"v": 1, "kind": "input_server", "event": "stats", "server_id": 0,
+         "shards": 3, "streamed_mb": 6.0, "wall_s": 4.0, "errors": 0,
+         "shard_s_p50": 0.02, "credit_stall_s": 0.5, "decode_s": 0.4},
+    ]
+    isv = summarize(records)["input_servers"]
+    stats = isv["servers"]["0"]["stats"]
+    assert stats["shards"] == 8
+    assert stats["streamed_mb"] == 16.0
+    assert stats["errors"] == 1
+    assert stats["wall_s"] == 34.0
+    assert stats["shard_s_p50"] == 0.02
+    assert isv["totals"] == {"shards": 8, "streamed_mb": 16.0,
+                             "errors": 1}
+
+    # pid-stamped records detect the relaunch EXACTLY: here the new
+    # life's first snapshot already exceeds the old life's last (no
+    # counter ever decreases), which the legacy heuristic would miss
+    pid_records = [
+        {"v": 1, "kind": "input_server", "event": "stats", "server_id": 1,
+         "pid": 100, "shards": 1, "streamed_mb": 2.0, "wall_s": 2.0,
+         "errors": 0},
+        {"v": 1, "kind": "input_server", "event": "stats", "server_id": 1,
+         "pid": 200, "shards": 1, "streamed_mb": 2.0, "wall_s": 8.0,
+         "errors": 0},
+    ]
+    stats = summarize(pid_records)["input_servers"]["servers"]["1"]["stats"]
+    assert stats["shards"] == 2
+    assert stats["wall_s"] == 10.0
+    assert "_stats_pid" not in summarize(pid_records)[
+        "input_servers"]["servers"]["1"]
+
+
+def test_service_dataset_len_from_meta_probe():
+    """input_service without the kNN monitor must not need a local
+    dataset build: the length comes from one handshake meta probe (the
+    remote-decode topology's train host may not even mount the data
+    tree); an unreachable pool refuses loudly."""
+    from moco_tpu.train import _service_dataset_len
+
+    worker = _start_worker(_dataset())
+    try:
+        meta = protocol.fetch_meta(worker.host, worker.port)
+        assert meta is not None and meta["n"] == N_SAMPLES
+        assert _service_dataset_len(
+            f"{worker.host}:{worker.port}") == N_SAMPLES
+    finally:
+        worker.stop(timeout_s=1.0)
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    free_port = probe.getsockname()[1]
+    probe.close()
+    with pytest.raises(ServiceConfigError, match="meta probe"):
+        _service_dataset_len([("127.0.0.1", free_port)])
+
+
+def test_serve_shard_spans_continue_coordinator_trace(mesh8, tmp_path):
+    """The cross-process critical-path story: the worker's serve_shard
+    spans parent under the SAME trace as the client coordinator's
+    stage_batch spans — what lets trace_report show decode on/off the
+    train host's critical path across the process edge."""
+    from moco_tpu.telemetry.trace import Tracer
+
+    client_tracer = Tracer(str(tmp_path / "driver"), "full", proc="driver")
+    worker_tracer = Tracer(str(tmp_path / "staging0"), "full",
+                           proc="staging0")
+    worker = _start_worker(_dataset(), tracer=worker_tracer)
+    client = None
+    try:
+        client = service_epoch_loader(
+            f"{worker.host}:{worker.port}", N_SAMPLES, 1, 0,
+            GLOBAL_BATCH, mesh8, streams=2, tracer=client_tracer)
+        _drain(client)
+    finally:
+        if client is not None:
+            client.close_quietly()
+        worker.stop(timeout_s=1.0)
+        client_tracer.close()
+        worker_tracer.close()
+    spans = []
+    with open(tmp_path / "staging0" / "spans.jsonl",
+              encoding="utf-8") as f:
+        for line in f:
+            spans.append(json.loads(line))
+    served = [s for s in spans if s["name"] == "serve_shard"]
+    assert served, "worker recorded no serve_shard spans"
+    assert all(s.get("parent") for s in served)
+    # the trace id IS the client tracer's: one merged timeline
+    assert {s["trace"] for s in served} == {client_tracer.trace_id}
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 drill: SIGKILL one of two real servers mid-epoch
+# ---------------------------------------------------------------------------
+
+
+def test_kill_one_server_drill_epoch_bit_identical(mesh8, tmp_path):
+    """The ISSUE 14 acceptance drill, on real server processes: poison
+    server 0 with kill_at_shard (self-SIGKILL before answering its 2nd
+    shard), run a full epoch -> every shard re-lands on server 1, the
+    epoch output is bit-identical to in-process staging, zero batches
+    lost — and the staging supervisor relaunches the dead worker without
+    re-firing the drill (fire-once chaos state)."""
+    want = _reference_epoch(mesh8)
+    chaos_state = tmp_path / "chaos_state"
+    from moco_tpu.serve.fleet import FleetPolicy
+
+    pool = LocalServerPool(
+        2,
+        ["--dataset", "synthetic", "--num-samples", str(N_SAMPLES),
+         "--image-size", "32", "--seed", "0"],
+        telemetry_root=str(tmp_path),
+        policy=FleetPolicy(probe_secs=0.2, startup_grace_secs=60.0,
+                           backoff_base_secs=0.1, backoff_max_secs=0.5),
+        per_server_env={0: {"MOCO_TPU_CHAOS": "kill_at_shard=2",
+                            "MOCO_TPU_CHAOS_STATE": str(chaos_state)}},
+    )
+    client = None
+    try:
+        pool.start()
+        assert pool.wait_healthy(60.0), "pool never became healthy"
+        client = service_epoch_loader(
+            pool.endpoints_spec(), N_SAMPLES, 1, 0, GLOBAL_BATCH, mesh8,
+            streams=2, backoff_secs=0.05, request_timeout_s=10.0)
+        got = _drain(client)
+        _assert_batches_equal(got, want)  # bit-identical, zero lost
+        # the drill really fired (fire-once marker persisted) ...
+        assert os.path.exists(chaos_state / "fired_kill_shard")
+        # ... and the supervisor relaunches the SIGKILLed worker; the
+        # chaos marker keeps the relaunch from crash-looping. (Wait for
+        # launches >= 2, not worker_healthy alone: right after the kill
+        # the probe state is still the STALE pre-kill healthy.)
+        server0 = pool.servers[0]
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if server0.worker.launches >= 2 and server0.worker_healthy():
+                break
+            time.sleep(0.1)
+        assert server0.worker.launches >= 2, \
+            "server 0 never relaunched after the chaos SIGKILL"
+        assert server0.worker_healthy(), "relaunched worker never probed ok"
+        events = [json.loads(line) for line in open(
+            tmp_path / "staging_server0" / "events.jsonl",
+            encoding="utf-8")]
+        exits = [e for e in events if e["event"] == "worker_exit"]
+        assert any(e["returncode"] == -9 for e in exits)  # the SIGKILL
+        assert any(e["event"] == "launch" and e["attempt"] >= 1
+                   for e in events)  # the relaunch
+    finally:
+        if client is not None:
+            client.close_quietly()
+        pool.close_quietly()
+
+
+@pytest.mark.slow
+def test_prestage_served_pool_soak(mesh8, tmp_path):
+    """Multi-process soak (slow): a 2-server pool answering from a shared
+    pre-staged epoch cache serves TWO bit-identical epochs; /stats on the
+    health endpoint reports the shards served; a stall drill on one
+    server is absorbed by the request timeout + retry path."""
+    import urllib.request
+
+    root = str(tmp_path / "pre")
+    write_prestage(_dataset(), root)
+    want1 = _reference_epoch(mesh8, epoch=1)
+    want2 = _reference_epoch(mesh8, epoch=2)
+    from moco_tpu.serve.fleet import FleetPolicy
+
+    pool = LocalServerPool(
+        2, ["--prestage", root],
+        telemetry_root=str(tmp_path),
+        policy=FleetPolicy(probe_secs=0.2, startup_grace_secs=60.0),
+        per_server_env={1: {"MOCO_TPU_CHAOS":
+                            "stall_at_shard=1,stall_ms=1500",
+                            "MOCO_TPU_CHAOS_STATE":
+                            str(tmp_path / "chaos_state")}},
+    )
+    client = None
+    try:
+        pool.start()
+        assert pool.wait_healthy(60.0), "pool never became healthy"
+        for epoch, want in ((1, want1), (2, want2)):
+            client = service_epoch_loader(
+                pool.endpoints_spec(), N_SAMPLES, epoch, 0, GLOBAL_BATCH,
+                mesh8, streams=2, backoff_secs=0.05,
+                request_timeout_s=1.0)
+            try:
+                got = _drain(client)
+            finally:
+                client.close_quietly()
+                client = None
+            _assert_batches_equal(got, want)
+        with urllib.request.urlopen(
+                "http://127.0.0.1:"
+                f"{pool.servers[0].health_port}/stats",
+                timeout=5.0) as resp:
+            stats = json.load(resp)
+        assert stats["worker_stats"].get("shards", 0) >= 1
+    finally:
+        if client is not None:
+            client.close_quietly()
+        pool.close_quietly()

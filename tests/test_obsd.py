@@ -237,9 +237,11 @@ def test_load_rules_default_set_and_file(tmp_path):
     rules = load_rules(None)
     names = {r.name for r in rules}
     # the documented default set: step-time p95, data-stall share, shed
-    # rate, reload failure, NaN/rollback, resize loop
+    # rate, input credit stall (ISSUE 14), reload failure, NaN/rollback,
+    # resize loop
     assert names == {"step_time_p95", "data_stall_share", "shed_rate",
-                     "reload_failure", "nonfinite_loss", "resize_loop"}
+                     "input_credit_stall", "reload_failure",
+                     "nonfinite_loss", "resize_loop"}
     path = tmp_path / "rules.json"
     path.write_text(json.dumps({"rules": [
         {"name": "a", "objective": "step_time_ms_p95", "threshold": 5},

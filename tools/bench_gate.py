@@ -99,6 +99,25 @@ def _iter_metric_records(source) -> list[dict]:
     return records
 
 
+def _fold_service_rows(container: dict, fallback_name: str,
+                       flat: dict) -> int:
+    """Fold the ISSUE 14 service/prestage e2e rows of one record (or of
+    its nested `e2e` dict) into `flat`; they gate under their own metric
+    names once a round carries them. Returns the number of per-server
+    `detail` rows excluded (the same rule as per-thread rows)."""
+    details = 0
+    for sub in ("service", "prestage"):
+        s = container.get(sub)
+        if not isinstance(s, dict):
+            continue
+        sv = s.get("value")
+        sname = str(s.get("metric", f"{fallback_name}/{sub}"))
+        if isinstance(sv, (int, float)) and sv > 0:
+            flat[sname] = float(sv)
+        details += len(s.get("detail") or ())
+    return details
+
+
 def flatten(source) -> tuple[dict, int]:
     """(metric_key -> value, skipped_detail_rows). Later records win on
     key collision (bench.py prints provisional lines first and the
@@ -126,6 +145,10 @@ def flatten(source) -> tuple[dict, int]:
             ename = str(e2e.get("metric", f"{name}/e2e"))
             if isinstance(v, (int, float)) and v > 0:
                 flat[ename] = float(v)
+            details += _fold_service_rows(e2e, ename, flat)
+        # same rows when flatten is fed the e2e CHILD's own record (the
+        # consolidated BENCH wrapper nests them under "e2e" instead)
+        details += _fold_service_rows(rec, name, flat)
         ho = rec.get("health_overhead")
         if isinstance(ho, dict):
             v = ho.get("overhead_pct_of_step_p50")

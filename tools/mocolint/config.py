@@ -115,7 +115,13 @@ SERVE_FORBIDDEN = (
 # Historical scoping of the monolithic linter, shared by both configs.
 _R1_R7_SCOPES = {
     "R3": RuleScope(exclude=("utils/logging.py", "utils/meters.py")),
-    "R4": RuleScope(exclude=("data/loader.py",)),
+    # R4 (ISSUE 14): the input-service constructions must close in a
+    # finally exactly like Prefetcher constructions; the implementation
+    # modules themselves are excluded like data/loader.py always was —
+    # they ARE the close machinery
+    "R4": RuleScope(exclude=("data/loader.py",
+                             "data/service/client.py",
+                             "data/service/fleet.py")),
     "R6": RuleScope(include=("moco_tpu/serve/",)),
     "R7": RuleScope(exclude=("moco_tpu/parallel/",)),
 }
@@ -193,7 +199,10 @@ DEFAULT_CONFIG = LintConfig(
                                  "tools/serve.py", "tools/serve_fleet.py")),
         # R6's historical scope is moco_tpu/serve/ (fleet.py rides along);
         # the fleet CLI lives in tools/ and must honor the same boundary
-        "R6": RuleScope(include=("moco_tpu/serve/", "tools/serve_fleet.py")),
+        "R6": RuleScope(include=("moco_tpu/serve/", "tools/serve_fleet.py",
+                                 "moco_tpu/data/service/",
+                                 "tools/staging_server.py",
+                                 "tools/prestage.py")),
         "R8": RuleScope(include=STEP_BUILDER_MODULES),
         "R9": RuleScope(include=BIT_IDENTITY_MODULES),
     },
@@ -260,6 +269,51 @@ DEFAULT_CONFIG = LintConfig(
                  "failures that kill jax (poisoned compile cache, OOM'd "
                  "runtime) — importing the stack it supervises couples "
                  "their fates"),
+        ),
+        # ISSUE 14: direct train-stack imports in the input service are
+        # R6 findings (the transitive chains are the R11 twin below)
+        Boundary(
+            name="input-service-train-free-direct",
+            rule_id="R6",
+            scope=("moco_tpu/data/service/", "tools/staging_server.py",
+                   "tools/prestage.py"),
+            forbid=SERVE_FORBIDDEN,
+            why=("the input service feeds training but must not import "
+                 "it — N staging servers dragging the optimizer stack "
+                 "would couple the input tier to the train stack"),
+        ),
+        # ISSUE 14: the staging-server control plane supervises numpy
+        # decode workers from OUTSIDE their process — the PR 4 contract
+        Boundary(
+            name="staging-server-stdlib-only",
+            rule_id="R11",
+            scope=("moco_tpu/data/service/server.py",
+                   "moco_tpu/data/service/fleet.py",
+                   "moco_tpu/data/service/protocol.py",
+                   "tools/staging_server.py"),
+            stdlib_only=True,
+            allow_prefixes=("moco_tpu",),
+            transitive=True,
+            why=("the staging-server supervisor half must outlive a "
+                 "wedged or OOM'd decode runtime — it answers /healthz "
+                 "503, classifies the death and relaunches; importing "
+                 "jax/numpy (directly or through a moco_tpu module) "
+                 "couples its fate to the worker it exists to restart"),
+        ),
+        # ISSUE 14: decode workers may import numpy, but never the train
+        # stack — a staging fleet's availability must not depend on it
+        Boundary(
+            name="input-service-train-free",
+            rule_id="R11",
+            scope=("moco_tpu/data/service/", "tools/staging_server.py",
+                   "tools/prestage.py"),
+            forbid=SERVE_FORBIDDEN,
+            transitive=True,
+            why=("the input service feeds training but must not import "
+                 "it: the optimizer stack in every staging server would "
+                 "bloat N decode processes and couple their restarts to "
+                 "the train stack (the R6 serve rule, applied to the "
+                 "input side)"),
         ),
         Boundary(
             name="checkpoint-orbax-lazy",

@@ -1,6 +1,10 @@
-"""R4: every Prefetcher/epoch_loader construction must be closed.
+"""R4: every loader/service construction must be closed.
 
-The staging threads and `depth` device batches leak otherwise. A
+Prefetcher/epoch_loader leak staging threads and `depth` device batches
+otherwise; the input-service constructions (ISSUE 14) additionally leak
+sockets and decode-worker SUBPROCESSES — a ServiceClient left open keeps
+its credit window pinned on every server, and an unclosed StagingServer/
+LocalServerPool leaves orphan worker processes decoding for nobody. A
 construction returned directly is the factory pattern and exempt: the
 caller owns the close.
 """
@@ -12,7 +16,9 @@ import ast
 from tools.mocolint.astutil import call_name
 from tools.mocolint.registry import Rule, register
 
-LOADER_FACTORIES = {"Prefetcher", "epoch_loader"}
+LOADER_FACTORIES = {"Prefetcher", "epoch_loader",
+                    "ServiceClient", "service_epoch_loader",
+                    "StagingServer", "LocalServerPool"}
 
 
 def _walk_shallow(node):
@@ -71,15 +77,15 @@ class UnclosedLoader(Rule):
             if var is None:
                 yield self.finding(
                     ctx, lineno,
-                    "Prefetcher/epoch_loader constructed without binding a "
+                    "loader/service constructed without binding a "
                     "name — the staging threads can never be close()d; bind "
                     "it and close in a finally",
                 )
             elif var not in closed_in_finally:
                 yield self.finding(
                     ctx, lineno,
-                    f"`{var} = ...` builds a Prefetcher but no `finally` in "
-                    f"this function calls `{var}.close()`/"
+                    f"`{var} = ...` builds a loader/service but no `finally` "
+                    f"in this function calls `{var}.close()`/"
                     f"`{var}.close_quietly()` — an early break leaks the "
-                    "staging threads and the staged batches",
+                    "staging threads/sockets and the staged batches",
                 )

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""prestage — decode a whole dataset once into a pre-staged epoch cache.
+
+    python tools/prestage.py /fast/ssd/imagenet_prestage \
+        --dataset imagefolder --data-dir /data/imagenet/train
+
+Writes the mmap-able packed-canvas format of
+`moco_tpu/data/service/prestage.py` (canvases.u8 / extents.i32 /
+labels.i32 / meta.json, meta landing LAST as the completeness marker).
+The staged canvas is a pure deterministic function of the file bytes —
+every randomized transform runs ON DEVICE — so ONE prestage serves every
+epoch of every run on every host at memcpy speed:
+
+    in-process:  train.py --input-prestage /fast/ssd/imagenet_prestage
+    service:     tools/staging_server.py --prestage /fast/ssd/...
+
+This CLI is offline tooling on the numpy side (it IS the decode), so it
+shares the worker's dataset flag surface verbatim and is exempt from the
+control plane's stdlib-only diet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from moco_tpu.data.service.prestage import PrestageError, write_prestage
+from moco_tpu.data.service.worker import add_dataset_flags, build_worker_dataset
+from moco_tpu.resilience.exitcodes import EXIT_CONFIG_ERROR, EXIT_OK
+from moco_tpu.utils.logging import log_event
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="decode a dataset once into a pre-staged epoch cache",
+    )
+    parser.add_argument("root", help="output directory")
+    add_dataset_flags(parser)
+    parser.add_argument("--chunk", type=int, default=64,
+                        help="decode-slice rows per memmap write")
+    args = parser.parse_args(argv)
+    if args.prestage:
+        log_event("prestage",
+                  "--prestage names an input cache; prestaging a "
+                  "prestage is a copy, not a decode — refusing")
+        return EXIT_CONFIG_ERROR
+    try:
+        dataset, _ = build_worker_dataset(args)
+    except (ValueError, OSError) as e:
+        # OSError, not just FileNotFoundError: --data-dir at a file or
+        # unreadable is the same config class (worker.py's contract)
+        log_event("prestage", f"cannot build dataset: {e}")
+        return EXIT_CONFIG_ERROR
+
+    t0 = time.perf_counter()
+    state = {"last": 0.0}
+
+    def progress(done: int, total: int) -> None:
+        now = time.perf_counter()
+        if now - state["last"] >= 5.0 or done == total:
+            state["last"] = now
+            rate = done / max(now - t0, 1e-9)
+            log_event("prestage",
+                      f"{done}/{total} rows ({rate:.0f} rows/s)")
+
+    try:
+        meta = write_prestage(dataset, args.root, chunk=args.chunk,
+                              progress=progress)
+    except PrestageError as e:
+        log_event("prestage", f"refused: {e}")
+        return EXIT_CONFIG_ERROR
+    log_event(
+        "prestage",
+        f"complete: {meta['n']} rows, "
+        f"{meta['canvas_bytes'] / 2**30:.2f} GiB canvases in "
+        f"{time.perf_counter() - t0:.1f}s at {args.root}",
+    )
+    return EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

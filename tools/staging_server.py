@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""staging_server — one disaggregated input-service server (ISSUE 14).
+
+    python tools/staging_server.py --health-port 8080 \
+        --dataset imagefolder --data-dir /data/imagenet/train
+
+Runs the stdlib supervisor half of one staging server
+(`moco_tpu/data/service/server.py`): it binds the health endpoint
+(`/healthz`, `/stats`), spawns the numpy decode worker as a SUBPROCESS
+(`python -m moco_tpu.data.service.worker`) on the data port, probes it
+over the REAL serving path (a `ping` frame — an answer is the
+heartbeat), kills probe-stale workers (SIGTERM → grace → SIGKILL) and
+relaunches within a restart budget refunded on healthy lives.
+
+Flags this CLI does not recognize are forwarded VERBATIM to the decode
+worker (its `--dataset/--data-dir/--prestage/--cache-mb/...` surface —
+`worker.add_dataset_flags` is the single source), so the two halves
+cannot drift: the supervisor stays pure stdlib (mocolint R11
+`staging-server-stdlib-only` — it must outlive a wedged numpy/jax
+runtime) without re-declaring the worker's numpy-side flags.
+
+Exit codes (resilience/exitcodes.py): EXIT_STAGING_BIND=50 when the
+health port (or, classified from the worker, the data port) cannot be
+bound — reschedule-don't-retry, the serve-bind semantics; 45 on a
+config-class worker death; 0 on SIGTERM drain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from moco_tpu.data.service.server import StagingServer
+from moco_tpu.resilience.exitcodes import EXIT_OK, EXIT_STAGING_BIND
+from moco_tpu.serve.fleet import FleetPolicy
+from moco_tpu.utils.logging import log_event
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="one staging server: stdlib supervisor + decode-"
+                    "worker subprocess (unrecognized flags forward to "
+                    "the worker)",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--data-port", type=int, default=0,
+                        help="frame-protocol port (0 = auto)")
+    parser.add_argument("--health-port", type=int, default=0,
+                        help="/healthz + /stats port (0 = auto)")
+    parser.add_argument("--server-id", type=int, default=0)
+    parser.add_argument("--telemetry-dir", default="",
+                        help="events.jsonl + worker.log + spans land "
+                             "here (default ./staging_server<id>)")
+    parser.add_argument("--probe-secs", type=float, default=1.0)
+    parser.add_argument("--health-stale-secs", type=float, default=10.0)
+    parser.add_argument("--startup-grace-secs", type=float, default=60.0)
+    parser.add_argument("--max-restarts", type=int, default=5)
+    args, worker_args = parser.parse_known_args(argv)
+
+    policy = FleetPolicy(
+        probe_secs=args.probe_secs,
+        health_stale_secs=args.health_stale_secs,
+        startup_grace_secs=args.startup_grace_secs,
+        max_restarts=args.max_restarts,
+    )
+    try:
+        server = StagingServer(
+            worker_args, host=args.host, data_port=args.data_port,
+            health_port=args.health_port,
+            telemetry_dir=args.telemetry_dir, server_id=args.server_id,
+            policy=policy,
+        )
+    except OSError as e:
+        log_event("input_server",
+                  f"cannot bind health port {args.host}:"
+                  f"{args.health_port}: {e}")
+        return EXIT_STAGING_BIND
+
+    stop = threading.Event()
+
+    def _drain(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    try:
+        server.start()
+        log_event(
+            "input_server",
+            f"staging server {args.server_id}: data "
+            f"{server.host}:{server.data_port}, health "
+            f"http://{server.host}:{server.health_port}/healthz",
+        )
+        while not stop.is_set():
+            if server.abandoned_class() is not None:
+                # the worker died a fatal class or exhausted its budget:
+                # the supervisor speaks for the server it fronts
+                return server.exit_code()
+            time.sleep(0.2)
+        return EXIT_OK
+    finally:
+        server.close_quietly()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

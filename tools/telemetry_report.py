@@ -74,6 +74,11 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# stdlib-safe: aggregate is pinned pure-stdlib (R11 obsd-stdlib-only)
+from moco_tpu.telemetry.aggregate import TELEMETRY_SUBDIR_PREFIXES  # noqa: E402
+
 
 def load_events(path: str) -> tuple[list[dict], int]:
     """Parse a JSONL events file; returns (records, skipped_line_count)."""
@@ -105,8 +110,10 @@ def _percentile(values: list[float], q: float) -> float:
 
 def expand_events_arg(path: str) -> list[tuple[str, str]]:
     """`(label, events_path)` pairs for one CLI argument. A FILE is
-    itself (label ""); a DIRECTORY is a fleet telemetry dir (ISSUE 10):
-    its own events.jsonl plus every `replica*/events.jsonl` under it."""
+    itself (label ""); a DIRECTORY is a fleet telemetry dir (ISSUE 10:
+    its own events.jsonl plus every `replica*/events.jsonl`) or an
+    input-service telemetry root (ISSUE 14: the run's events.jsonl plus
+    every `staging_server*/events.jsonl` beside it)."""
     if not os.path.isdir(path):
         return [("", path)]
     pairs = []
@@ -115,7 +122,8 @@ def expand_events_arg(path: str) -> list[tuple[str, str]]:
         pairs.append(("fleet", own))
     for name in sorted(os.listdir(path)):
         sub = os.path.join(path, name, "events.jsonl")
-        if name.startswith("replica") and os.path.exists(sub):
+        if (name.startswith(TELEMETRY_SUBDIR_PREFIXES)
+                and os.path.exists(sub)):
             pairs.append((name, sub))
     if not pairs:
         raise OSError(f"no events.jsonl under directory {path}")
@@ -148,6 +156,7 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
     serves = [r for r in records if r.get("kind") == "serve"]
     fleet = [r for r in records if r.get("kind") == "fleet"]
     slos = [r for r in records if r.get("kind") == "slo"]
+    input_servers = [r for r in records if r.get("kind") == "input_server"]
 
     step_s = [r["step_s"] for r in steps if "step_s" in r]
     data_s = [r["data_s"] for r in steps if "data_s" in r]
@@ -307,6 +316,8 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
         summary["serve"]["snapshots"] = len(serves)
     if fleet:
         summary["fleet"] = _summarize_fleet(fleet, serves)
+    if input_servers:
+        summary["input_servers"] = _summarize_input_servers(input_servers)
     health_sec = _summarize_health(steps, events)
     if health_sec:
         summary["health"] = health_sec
@@ -315,6 +326,79 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
     if run_ends:
         summary["run_end"] = run_ends[-1]
     return summary
+
+
+_ADDITIVE_SERVER_STATS = ("shards", "streamed_mb", "decode_s",
+                          "credit_stall_s", "wall_s", "errors",
+                          "decode_failures", "decode_total")
+
+
+def _summarize_input_servers(records: list[dict]) -> dict:
+    """Fold the `kind:"input_server"` records of the staging-server
+    telemetry dirs (ISSUE 14): per server, the cumulative `stats`
+    counters SUMMED across decode-worker lives (a relaunch restarts
+    them from zero — detected as a counter decrease, the obsd
+    counter-reset discipline — so the kill-drill report still counts
+    every shard the pre-kill life served), latency p50/p95 and
+    cache-hit rate from the last life, plus the supervisor half's
+    lifecycle counts (launches/ejections/kills/death classes) — one
+    story per server, totals across the pool."""
+    by_server: dict[int, dict] = {}
+    for r in records:
+        sid = int(r.get("server_id", -1))
+        entry = by_server.setdefault(sid, {"events": {}})
+        event = str(r.get("event", "?"))
+        if event == "stats":
+            snap = {
+                k: r[k]
+                for k in ("shards", "streamed_mb", "shard_s_p50",
+                          "shard_s_p95", "decode_s", "credit_stall_s",
+                          "wall_s", "errors", "connections",
+                          "connections_peak", "cache_hit_rate",
+                          "decode_failures", "decode_total")
+                if k in r
+            }
+            prev = entry.get("stats")
+            pid, prev_pid = r.get("pid"), entry.get("_stats_pid")
+            if prev is not None:
+                if pid is not None and prev_pid is not None:
+                    # exact: a relaunch changes the worker pid — catches
+                    # a new life whose first snapshot already exceeds
+                    # the old life's last (counters never decreased)
+                    relaunched = pid != prev_pid
+                else:  # legacy records without pid: counter decrease
+                    relaunched = (
+                        snap.get("wall_s", 0) < prev.get("wall_s", 0)
+                        or snap.get("shards", 0) < prev.get("shards", 0))
+                if relaunched:
+                    base = entry.setdefault("_lives_base", {})
+                    for k in _ADDITIVE_SERVER_STATS:
+                        base[k] = base.get(k, 0) + prev.get(k, 0)
+            entry["_stats_pid"] = pid
+            entry["stats"] = snap
+        else:
+            entry["events"][event] = entry["events"].get(event, 0) + 1
+            if event == "worker_exit" and "classification" in r:
+                entry.setdefault("death_classes", []).append(
+                    str(r["classification"]))
+    servers = {}
+    totals = {"shards": 0, "streamed_mb": 0.0, "errors": 0}
+    for sid in sorted(by_server):
+        entry = by_server[sid]
+        stats = entry.get("stats", {})
+        entry.pop("_stats_pid", None)
+        base = entry.pop("_lives_base", None)
+        if base:
+            stats = dict(stats)
+            for k, v in base.items():
+                stats[k] = round(v + stats.get(k, 0), 3)
+            entry["stats"] = stats
+        totals["shards"] += stats.get("shards", 0)
+        totals["streamed_mb"] += stats.get("streamed_mb", 0.0)
+        totals["errors"] += stats.get("errors", 0)
+        servers[str(sid)] = entry
+    return {"servers": servers, "totals": totals,
+            "n_servers": len(servers)}
 
 
 def _summarize_health(steps: list[dict], events: list[dict]) -> dict | None:
@@ -642,6 +726,68 @@ def render(summary: dict) -> str:
                 f"({inp.get('cache_hits', 0)} hit / "
                 f"{inp.get('cache_misses', 0)} miss)"
             )
+        if inp.get("wall_s"):
+            lines.append(
+                f"  credit stalls: {inp.get('credit_stall_s', 0):.1f} s "
+                f"blocked on an empty ready queue "
+                f"({100 * inp.get('credit_stall_s', 0) / inp['wall_s']:.1f}% "
+                f"of {inp['wall_s']:.0f} s)"
+            )
+    isv = summary.get("input_servers")
+    if isv:
+        tot = isv.get("totals", {})
+        lines.append(
+            f"input service: {isv.get('n_servers', 0)} staging server(s) · "
+            f"{tot.get('shards', 0)} shards "
+            f"({tot.get('streamed_mb', 0):.0f} MiB streamed, "
+            f"{tot.get('errors', 0)} error(s))"
+        )
+        for sid, entry in sorted(isv.get("servers", {}).items(),
+                                 key=lambda kv: int(kv[0])):
+            stats = entry.get("stats", {})
+            parts = [f"  server {sid}:"]
+            if stats:
+                parts.append(
+                    f"{stats.get('shards', 0)} shards · shard p50 "
+                    f"{1e3 * stats.get('shard_s_p50', 0):.1f} ms / p95 "
+                    f"{1e3 * stats.get('shard_s_p95', 0):.1f} ms · "
+                    f"{stats.get('streamed_mb', 0):.0f} MiB"
+                )
+                if "cache_hit_rate" in stats:
+                    parts.append(
+                        f"· cache {100 * stats['cache_hit_rate']:.1f}% hit")
+                if stats.get("decode_failures"):
+                    parts.append(
+                        f"· DECODE FAILURES "
+                        f"{stats['decode_failures']}/"
+                        f"{stats.get('decode_total', 0)} (zero canvases "
+                        "served — the train host cannot see these)"
+                    )
+                if stats.get("wall_s"):
+                    # credit_stall_s accumulates CONCURRENTLY across the
+                    # client connections: normalize per connection or a
+                    # healthy 4-stream run renders a nonsense 360%. Peak,
+                    # not the live gauge — the final snapshot lands after
+                    # clients disconnected (gauge back at 0)
+                    conns = max(int(stats.get("connections_peak")
+                                    or stats.get("connections", 1)
+                                    or 1), 1)
+                    parts.append(
+                        f"· idle-for-credit "
+                        f"{100 * stats.get('credit_stall_s', 0) / (stats['wall_s'] * conns):.0f}%/conn"
+                    )
+            ev = entry.get("events", {})
+            life = []
+            for key in ("launch", "eject", "kill", "worker_exit",
+                        "give_up"):
+                if ev.get(key):
+                    life.append(f"{key}×{ev[key]}")
+            if life:
+                parts.append("· " + " ".join(life))
+            if entry.get("death_classes"):
+                parts.append(
+                    "(" + ", ".join(entry["death_classes"]) + ")")
+            lines.append(" ".join(parts))
     if "pod_step_spread_ms_max" in summary:
         lines.append(
             f"pod: {summary['pod_records']} records, worst cross-host step "
@@ -923,6 +1069,23 @@ def render_record(rec: dict) -> str | None:
             if k not in ("v", "t", "kind", "event", "run_id", "trace_id")
         )
         return f"fleet: {rec.get('event', '?')} {detail}".rstrip()
+    if kind == "input_server":
+        # staging-server stream (ISSUE 14): stats snapshots get a compact
+        # throughput line, lifecycle transitions the fleet-style detail
+        sid = rec.get("server_id", "?")
+        if rec.get("event") == "stats":
+            return (
+                f"input: server {sid} {rec.get('shards', 0)} shards · "
+                f"p50 {1e3 * rec.get('shard_s_p50', 0):.1f} ms · "
+                f"{rec.get('streamed_mb', 0):.0f} MiB · "
+                f"{rec.get('errors', 0)} error(s)"
+            )
+        detail = " ".join(
+            f"{k}={v}" for k, v in rec.items()
+            if k not in ("v", "t", "kind", "event", "run_id", "trace_id",
+                         "server_id")
+        )
+        return f"input: server {sid} {rec.get('event', '?')} {detail}".rstrip()
     if kind == "slo":
         # obsd transitions (ISSUE 12): an alert in progress must jump out
         # of the step stream the way resize/fleet lines do
