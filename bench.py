@@ -888,6 +888,74 @@ def _bench_serve_fleet(variables, serve_bench) -> dict:
 # headline's budget
 GRADSYNC_SWEEP_CAP_S = 150.0
 
+# same contract for the per-sharding-mode v3 sweep (ISSUE 15)
+SHARDING_SWEEP_CAP_S = 150.0
+
+
+def _sharding_sweep(mesh, n_chips: int, on_tpu: bool) -> dict:
+    """imgs/s + synced step percentiles + per-device state bytes per
+    `sharding` mode on the SAME v3 config (ISSUE 15 satellite) — the
+    trajectory rows that show what FSDP costs in step time and buys in
+    per-device footprint on this backend. Per-mode error isolation and a
+    wall-clock budget, exactly like the grad_sync sweep: a broken mode
+    costs only its own row. Peak HBM rides along where the backend's
+    allocator reports it (DeviceMonitor; absent on CPU)."""
+    from moco_tpu.config import get_preset
+    from moco_tpu.parallel.fsdp import state_bytes_per_device
+    from moco_tpu.parallel.mesh import mesh_for_config
+    from moco_tpu.telemetry.device import DeviceMonitor
+    from moco_tpu.utils.benchkit import (
+        build_v2_fused_bench,
+        time_step_percentiles,
+    )
+
+    if on_tpu:
+        base = get_preset("imagenet-moco-v3-vits").replace(
+            batch_size=64 * n_chips, dataset="synthetic", remat=True)
+        warm, steps = 2, 4
+    else:  # CPU proxy: the tiny ViT (width 64, depth 2) keeps the three
+        # extra compiles inside the sweep budget
+        base = get_preset("imagenet-moco-v3-vits").replace(
+            arch="vit_tiny", compute_dtype="float32", image_size=32,
+            batch_size=8 * n_chips, embed_dim=32, dataset="synthetic",
+            warmup_epochs=0, lr=1e-3, base_lr=0.0)
+        warm, steps = 2, 3
+    modes = ["dp"]
+    if n_chips >= 2:
+        modes.append("fsdp")
+    if n_chips >= 4:
+        modes.append("fsdp_tp")
+    detail = {}
+    deadline = time.monotonic() + float(
+        os.environ.get("MOCO_TPU_BENCH_SHARDING_S", SHARDING_SWEEP_CAP_S))
+    for mode in modes:
+        if time.monotonic() > deadline:
+            detail[mode] = {"skipped": "sweep budget exhausted"}
+            continue
+        try:
+            cfg = base.replace(sharding=mode)
+            m_mode = mesh_for_config(cfg, mesh)
+            fused, state, imgs_u8, extents = build_v2_fused_bench(cfg, m_mode)
+            m = None
+            for w in range(warm):
+                state, m = fused(state, imgs_u8, extents, w)
+            assert np.isfinite(float(m["loss"])), f"non-finite {mode} loss"
+            pcts, state = time_step_percentiles(
+                fused, state, imgs_u8, extents, steps=steps)
+            row = {
+                "imgs_per_sec_per_chip": round(
+                    cfg.batch_size / (pcts["p50"] / 1e3) / n_chips, 2),
+                "step_time_synced_ms": pcts,
+                **state_bytes_per_device(state),
+            }
+            hbm = DeviceMonitor().sample()
+            if "hbm_peak_bytes" in hbm:
+                row["hbm_peak_bytes"] = hbm["hbm_peak_bytes"]
+            detail[mode] = row
+        except Exception as e:  # noqa: BLE001 — degraded row, never fatal
+            detail[mode] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return detail
+
 
 def _grad_sync_sweep(config, mesh, n_chips: int, fused_pcts: dict) -> dict:
     """imgs/s + synced step-time percentiles per grad_sync mode on the SAME
@@ -1083,6 +1151,9 @@ def main():
     # headline above IS the fused row, so only the three comm-efficient
     # modes compile extra programs
     grad_sync_detail = _grad_sync_sweep(config, mesh, n_chips, step_pcts)
+    # per-sharding-mode v3 comparison (ISSUE 15): dp/fsdp/fsdp_tp rows on
+    # one v3 config — throughput, synced percentiles, per-device bytes
+    sharding_detail = _sharding_sweep(mesh, n_chips, on_tpu)
     # span-layer overhead row (ISSUE 8 acceptance: trace_mode=steps must
     # cost well under 3% of step time vs off)
     telemetry_detail = _telemetry_overhead_row(step_pcts["p50"])
@@ -1103,6 +1174,7 @@ def main():
                 "final_loss": round(loss, 4),
                 "step_time_synced_ms": step_pcts,
                 "grad_sync": grad_sync_detail,
+                "sharding": sharding_detail,
                 "telemetry_overhead": telemetry_detail,
                 "health_overhead": health_detail,
                 # measured cold/warm compile evidence (VERDICT r4 #2): on

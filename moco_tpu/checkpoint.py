@@ -58,8 +58,23 @@ TRAIN_STATE_DIALECTS = {
     1: "pre-gradsync TrainState (PRs 1-5): no gradsync leaves",
     2: "gradsync accumulators: optional gradsync/acc [n_dev, ...] leaves "
        "(grad_sync quantized/demo; empty tree for fused/bucketed)",
+    # Dialect 3 (ISSUE 15) is dialect 2 PLUS the sharded-state contract:
+    # under sharding=fsdp/fsdp_tp every params/opt leaf keeps its LOGICAL
+    # shape (parallel/fsdp.py shards an axis of the same array), so on
+    # disk a sharded state is indistinguishable from a dp state and
+    # dp→fsdp / fsdp→dp / N→M-device restores are ordinary restores into
+    # a different placement (`restore_checkpoint(sharding=<tree>)` — a
+    # TrainState-shaped tree of NamedShardings places each leaf directly).
+    # Only the gradsync accumulators are layout-bound: mesh-SIZE changes
+    # ride the dialect-2 shim below, and sharding-MODE changes at equal
+    # mesh size (same acc shapes — structurally invisible here) are
+    # caught by the DRIVER against the position sidecar's `sharding`
+    # stamp, which zeroes the EF state with a ckpt-dialect event.
+    3: "sharded-state (sharding=fsdp/fsdp_tp): same logical tree as 2, "
+       "restorable into any placement; `sharding` stamped in the "
+       "position sidecar",
 }
-TRAIN_STATE_DIALECT = 2
+TRAIN_STATE_DIALECT = 3
 
 
 def checkpoint_manager(directory: str, max_to_keep: int = 3) -> "ocp.CheckpointManager":
@@ -90,7 +105,8 @@ def _position_path(directory: str, step: int) -> str:
 
 def write_position(directory: str, step: int,
                    position: tuple[int, int] | None,
-                   devices: int | None = None) -> None:
+                   devices: int | None = None,
+                   sharding: str | None = None) -> None:
     """Record the data-stream position `(epoch, next_batch_index)` the run
     will be at when restored from `step`. `step // steps_per_epoch`
     arithmetic recovers it ONLY while steps and batches are aligned — a NaN
@@ -102,7 +118,11 @@ def write_position(directory: str, step: int,
     `devices` (the mesh size the state was saved under, ISSUE 11) rides
     the same sidecar so the jax-free supervisor can flag a `mesh_change`
     at relaunch preflight (resize.read_recorded_devices) instead of the
-    restore shim discovering it mid-restore."""
+    restore shim discovering it mid-restore. `sharding` (ISSUE 15) records
+    the sharding MODE the state was saved under: a mode change is
+    structurally invisible to the gradsync shim (acc shapes match at equal
+    mesh size), so the driver reads this stamp to know the EF state must
+    restart fresh-zero."""
     if position is None or jax.process_index() != 0:
         return
     path = _position_path(directory, step)
@@ -110,6 +130,8 @@ def write_position(directory: str, step: int,
     payload = {"epoch": int(position[0]), "batch": int(position[1])}
     if devices is not None:
         payload["devices"] = int(devices)
+    if sharding is not None:
+        payload["sharding"] = str(sharding)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(payload, f)
@@ -121,6 +143,19 @@ def read_position(directory: str, step: int) -> tuple[int, int] | None:
         with open(_position_path(directory, step)) as f:
             d = json.load(f)
         return int(d["epoch"]), int(d["batch"])
+    except (OSError, ValueError, KeyError, TypeError, json.JSONDecodeError):
+        return None
+
+
+def read_recorded_sharding(directory: str, step: int) -> str | None:
+    """The sharding mode `step` was saved under (ISSUE 15), None when the
+    sidecar predates the stamp (pre-sharding checkpoints — treated as
+    'dp' by the driver) or is unreadable."""
+    try:
+        with open(_position_path(directory, step)) as f:
+            d = json.load(f)
+        mode = d.get("sharding")
+        return str(mode) if mode is not None else None
     except (OSError, ValueError, KeyError, TypeError, json.JSONDecodeError):
         return None
 
@@ -150,6 +185,7 @@ def _prune_sidecars(mgr: ocp.CheckpointManager) -> None:
 def save_checkpoint(
     mgr: ocp.CheckpointManager, state: TrainState, step: int, wait: bool = True,
     position: tuple[int, int] | None = None, devices: int | None = None,
+    sharding: str | None = None,
 ) -> None:
     """Save `state` at `step`. With `wait=True` (default), block until the
     save finalizes and record an integrity manifest sidecar (process 0) so a
@@ -167,7 +203,8 @@ def save_checkpoint(
     import orbax.checkpoint as ocp
 
     finalize_checkpoints(mgr)
-    write_position(str(mgr.directory), step, position, devices=devices)
+    write_position(str(mgr.directory), step, position, devices=devices,
+                   sharding=sharding)
     mgr.save(step, args=ocp.args.StandardSave(_unkey(state)))
     if wait:
         mgr.wait_until_finished()
@@ -203,14 +240,38 @@ def _restore_step(
     import orbax.checkpoint as ocp
 
     target = _unkey(abstract_state)
-    if sharding is not None:
+    # `sharding` is one Sharding applied to every leaf (the replicated
+    # restore every dp run does), or — ISSUE 15, dialect 3 — a TrainState-
+    # shaped TREE of NamedShardings (fsdp: each leaf lands directly in its
+    # per-leaf placement; Orbax reads only the shards each host owns).
+    leaf_sharding = None   # the per-leaf fallback _restore_fresh_gradsync
+    if sharding is not None:  # uses for metadata-rebuilt accumulator leaves
         import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _P
 
-        def to_abstract(x):
+        sharding_is_tree = not isinstance(sharding, jax.sharding.Sharding)
+
+        def to_abstract(x, s):
             x = jnp.asarray(x)
-            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
 
-        target = jax.tree.map(to_abstract, target)
+        if sharding_is_tree:
+            # leaf-wise zip: the tree mirrors the state's structure (a
+            # NamedSharding is itself a leaf, including at the rng slot)
+            target = jax.tree.map(to_abstract, target, sharding)
+            any_leaf = next(
+                (s for s in jax.tree.leaves(
+                    sharding, is_leaf=lambda x: isinstance(
+                        x, jax.sharding.Sharding))
+                 if isinstance(s, NamedSharding)), None)
+            # mesh-replicated: metadata-rebuilt gradsync leaves have
+            # checkpoint-side shapes a per-leaf plan knows nothing about
+            leaf_sharding = (NamedSharding(any_leaf.mesh, _P())
+                             if any_leaf is not None else None)
+        else:
+            target = jax.tree.map(lambda x: to_abstract(x, sharding), target)
+            leaf_sharding = sharding
     def _sig(tree):
         return [
             (jax.tree_util.keystr(p), tuple(leaf.shape))
@@ -232,9 +293,9 @@ def _restore_step(
         }
         if md_gs is not None and jax.tree.leaves(md_gs):
             def from_md(m):
-                if sharding is not None:
+                if leaf_sharding is not None:
                     return jax.ShapeDtypeStruct(m.shape, m.dtype,
-                                                sharding=sharding)
+                                                sharding=leaf_sharding)
                 return jax.ShapeDtypeStruct(m.shape, m.dtype)
 
             stripped["gradsync"] = jax.tree.map(from_md, md_gs)

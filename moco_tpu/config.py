@@ -40,6 +40,31 @@ class PretrainConfig:
                                       # the data axis (HBM/N footprint, one
                                       # all-gather of updates per step;
                                       # identical numerics — parallel/zero)
+    # scale-out sharding (ISSUE 15; parallel/fsdp.py — see README
+    # "Sharding modes" for the mode table and composition matrix)
+    sharding: str = "dp"              # "dp" (seed layout: 1-D mesh, params
+                                      # replicated — bitwise the pre-ISSUE-15
+                                      # program) | "fsdp" (v3 only: params +
+                                      # optimizer state sharded 1/N over the
+                                      # fsdp mesh axis, all-gather-on-use,
+                                      # grads reduce-scattered through
+                                      # GradSync) | "fsdp_tp" (2-D hybrid:
+                                      # shard over the fast inner axis,
+                                      # replicate over the slow outer one;
+                                      # quantized grad_sync upgrades to the
+                                      # DynamiQ-style multi-hop reduce)
+    sharding_axis_size: int = 0       # fsdp-axis (inner/fast) device count
+                                      # for fsdp_tp; 0 = derive (all devices
+                                      # for fsdp, largest proper divisor for
+                                      # fsdp_tp). Must divide the device
+                                      # count.
+    collective_chunks: int = 1        # FAST-style chunked scheduling for
+                                      # the ShuffleBN / v3 key-gather
+                                      # all-to-alls: split each gather into
+                                      # N barrier-chained chunk collectives
+                                      # that pipeline with compute.
+                                      # Bit-identical reassembly; 1 = one
+                                      # monolithic gather (seed behavior)
     # gradient sync (ISSUE 6; parallel/gradsync.py — see README "Gradient
     # sync modes" for the mode table and convergence caveats)
     grad_sync: str = "fused"          # "fused" (exact DP, one tree pmean —
@@ -352,6 +377,39 @@ class PretrainConfig:
                     "servers at it instead "
                     "(tools/staging_server.py --prestage <dir>)"
                 )
+        # sharding knobs (ISSUE 15): literals kept in sync with
+        # parallel/mesh.SHARDING_MODES — config must stay importable
+        # without jax
+        if self.sharding not in ("dp", "fsdp", "fsdp_tp"):
+            raise ValueError(
+                f"unknown sharding {self.sharding!r}; choose from "
+                "dp/fsdp/fsdp_tp"
+            )
+        if self.sharding != "dp" and self.variant != "v3":
+            raise ValueError(
+                f"sharding={self.sharding!r} requires variant='v3': the "
+                "queue-based v1/v2 step needs the replicated queue's "
+                "identical-enqueue invariant (and its encoders fit "
+                "per-chip) — FSDP targets the queue-free large-batch v3 "
+                "regime"
+            )
+        if self.sharding_axis_size < 0:
+            raise ValueError(
+                f"sharding_axis_size must be >= 0, got "
+                f"{self.sharding_axis_size}"
+            )
+        if self.sharding != "dp" and self.zero_sharding:
+            raise ValueError(
+                "zero_sharding and sharding=fsdp/fsdp_tp are mutually "
+                "exclusive: fsdp already shards the optimizer state over "
+                "the fsdp axis — re-placing it with the ZeRO-1 data-axis "
+                "layout would silently re-replicate the shards"
+            )
+        if self.collective_chunks < 1:
+            raise ValueError(
+                f"collective_chunks must be >= 1, got "
+                f"{self.collective_chunks}"
+            )
         # grad-sync knobs (ISSUE 6): literals kept in sync with
         # parallel/gradsync.GRAD_SYNC_MODES — config must stay importable
         # without jax (the serve/stdlib processes)

@@ -573,8 +573,14 @@ def build_two_crops_sharded(cfg, mesh):
     `(cfg_view1, cfg_view2)` pair (v3's asymmetric blur/solarize recipes)."""
     from jax.sharding import PartitionSpec as P
 
-    from moco_tpu.parallel.mesh import DATA_AXIS
+    from moco_tpu.parallel.collectives import batch_axis_index
+    from moco_tpu.parallel.mesh import batch_axes
 
+    # the batch axis set: "data" on the 1-D mesh, ("data","fsdp") on the
+    # 2-D one (ISSUE 15) — global sample indices stay identical because
+    # the combined index ravels in the gather's own device order
+    axes = batch_axes(mesh)
+    axis = axes[0] if len(axes) == 1 else axes
     if isinstance(cfg, AugConfig):  # NB: AugConfig IS a tuple — check first
         cfg_q = cfg_k = cfg
     else:
@@ -588,7 +594,7 @@ def build_two_crops_sharded(cfg, mesh):
 
     def body(imgs, extents, key):
         local_b = imgs.shape[0]
-        start = jax.lax.axis_index(DATA_AXIS) * local_b
+        start = batch_axis_index(axis) * local_b
         kq, kk = jax.random.split(key)
 
         def crop(k, c):
@@ -600,8 +606,8 @@ def build_two_crops_sharded(cfg, mesh):
         shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
-            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            in_specs=(P(axis), P(axis), P()),
+            out_specs=(P(axis), P(axis)),
         )
     )
 

@@ -146,7 +146,11 @@ class Prefetcher:
         self.indices = indices
         self.batch = batch_per_host
         self.mesh = mesh
-        self.sharding = NamedSharding(mesh, P(DATA_AXIS))
+        # leading dim split over every mesh axis (ISSUE 15: the 2-D
+        # data×fsdp mesh still spans the global batch across all devices)
+        from moco_tpu.parallel.mesh import batch_axes
+
+        self.sharding = NamedSharding(mesh, P(batch_axes(mesh)))
         self.num_batches = len(indices) // batch_per_host
         self.retries = retries
         self.backoff_secs = backoff_secs

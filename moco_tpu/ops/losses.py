@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from moco_tpu.parallel.collectives import all_gather_batch
+from moco_tpu.parallel.collectives import all_gather_batch, batch_axis_index
 
 
 def l2_normalize(x: jax.Array, eps: float = 1e-12) -> jax.Array:
@@ -83,7 +83,8 @@ def contrastive_accuracy(
 
 
 def v3_contrastive_loss(
-    q: jax.Array, k: jax.Array, temperature: float, axis_name: str | None
+    q: jax.Array, k: jax.Array, temperature: float, axis_name,
+    chunks: int = 1
 ) -> jax.Array:
     """One direction of the MoCo-v3 queue-free loss (SURVEY §3.5).
 
@@ -92,11 +93,15 @@ def v3_contrastive_loss(
     row i is global row `rank*B_local + i` (the reference's
     `labels = arange(N) + N*rank`). Loss is scaled by 2*T as in the paper's
     implementation. `q`/`k` must be L2-normalized, `k` stop-gradiented.
+
+    `axis_name` may be a tuple (the 2-D data×fsdp mesh, ISSUE 15); `chunks`
+    applies the FAST-style chunked gather schedule — the reassembled
+    negatives are bit-identical either way (collectives.all_gather_batch).
     """
     k = lax.stop_gradient(k)
     if axis_name is not None:
-        k_all = all_gather_batch(k, axis_name)
-        offset = lax.axis_index(axis_name) * q.shape[0]
+        k_all = all_gather_batch(k, axis_name, chunks)
+        offset = batch_axis_index(axis_name) * q.shape[0]
     else:
         k_all, offset = k, 0
     logits = (

@@ -1,30 +1,49 @@
 from moco_tpu.parallel.mesh import (
     DATA_AXIS,
+    FSDP_AXIS,
+    SHARDING_MODES,
+    batch_axes,
     create_mesh,
+    create_mesh_2d,
     force_cpu_devices,
     local_batch_size,
     distributed_init,
+    mesh_for_config,
 )
 from moco_tpu.parallel.collectives import (
     all_gather_batch,
+    batch_axis_index,
+    batch_axis_size,
     batch_shuffle,
     batch_unshuffle,
     chained_psum,
+    multihop_quantized_psum_mean,
     quantized_psum_mean,
 )
 from moco_tpu.parallel.gradsync import GRAD_SYNC_MODES, GradSync
+from moco_tpu.parallel.fsdp import ShardingPlan, plan_for
 
 __all__ = [
     "DATA_AXIS",
+    "FSDP_AXIS",
+    "SHARDING_MODES",
+    "batch_axes",
     "create_mesh",
+    "create_mesh_2d",
     "force_cpu_devices",
     "local_batch_size",
     "distributed_init",
+    "mesh_for_config",
     "all_gather_batch",
+    "batch_axis_index",
+    "batch_axis_size",
     "batch_shuffle",
     "batch_unshuffle",
     "chained_psum",
+    "multihop_quantized_psum_mean",
     "quantized_psum_mean",
     "GRAD_SYNC_MODES",
     "GradSync",
+    "ShardingPlan",
+    "plan_for",
 ]

@@ -37,20 +37,74 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from moco_tpu.utils.compat import axis_size
+from moco_tpu.utils.compat import axis_size, optimization_barrier
+
+# Every collective here accepts either one axis name or a TUPLE of names
+# (ISSUE 15: the 2-D data×fsdp mesh) — jax's collectives treat a tuple as
+# one combined device group in row-major order of the names given, so the
+# helpers below define the matching combined size/index once.
 
 
-def all_gather_batch(x: jax.Array, axis_name: str) -> jax.Array:
+def batch_axis_size(axis_name) -> jax.Array | int:
+    """Total device count of the (possibly multi-axis) batch group."""
+    if isinstance(axis_name, (tuple, list)):
+        n = 1
+        for ax in axis_name:
+            n = n * axis_size(ax)
+        return n
+    return axis_size(axis_name)
+
+
+def batch_axis_index(axis_name) -> jax.Array:
+    """This device's rank within the combined batch group, row-major in
+    the axis order given — by construction the position its tiled
+    `all_gather` shard lands at (pinned by tests/test_collectives.py)."""
+    if isinstance(axis_name, (tuple, list)):
+        idx = jnp.int32(0)
+        for ax in axis_name:
+            idx = idx * axis_size(ax) + lax.axis_index(ax)
+        return idx
+    return lax.axis_index(axis_name)
+
+
+def all_gather_batch(x: jax.Array, axis_name, chunks: int = 1) -> jax.Array:
     """Gather local batch shards into the global batch along dim 0.
 
     Equivalent of `concat_all_gather` (`moco/builder.py:≈L167-180`) minus the
     stop-grad (callers add it where the reference ran under no_grad).
+
+    `chunks > 1` is the FAST-style schedule (PAPERS.md): the local batch is
+    split into `chunks` row slices, each gathered as its OWN collective,
+    chained through `optimization_barrier` so they issue as a deterministic
+    pipeline — chunk i can be on the wire while the compute feeding chunk
+    i+1 still runs, instead of one monolithic end-of-phase gather. The
+    reassembled result is BIT-IDENTICAL to the unchunked gather (rows are
+    restitched device-major), so the knob is pure scheduling. A chunk
+    count the local batch does not divide falls back to the monolithic
+    gather (chunking is a hint, never a shape constraint).
     """
-    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+    if chunks <= 1 or x.shape[0] % chunks:
+        return lax.all_gather(x, axis_name, axis=0, tiled=True)
+    rows = x.shape[0] // chunks
+    gathered = []
+    prev = None
+    for c in range(chunks):
+        part = lax.slice_in_dim(x, c * rows, (c + 1) * rows, axis=0)
+        if prev is not None:
+            part, prev = optimization_barrier((part, prev))
+        g = lax.all_gather(part, axis_name, axis=0)  # [n, rows, ...]
+        gathered.append(g)
+        prev = g
+    # [C, n, rows, ...] -> [n, C, rows, ...] -> [n * C * rows, ...]:
+    # device-major, then original row order within each device's shard —
+    # exactly the tiled gather's layout
+    stacked = jnp.stack(gathered, axis=0)
+    moved = jnp.swapaxes(stacked, 0, 1)
+    return moved.reshape((-1,) + tuple(x.shape[1:]))
 
 
 def batch_shuffle(
-    x: jax.Array, key: jax.Array, axis_name: str
+    x: jax.Array, key: jax.Array, axis_name, chunks: int = 1
 ) -> tuple[jax.Array, jax.Array]:
     """Shuffle the global batch across devices; return (local shard, perm).
 
@@ -61,23 +115,28 @@ def batch_shuffle(
     `key` MUST be replicated across the mesh (derived by `fold_in` from the
     replicated train-state key) — divergent keys would silently desynchronise
     the shuffle; tests/test_collectives.py pins this.
+
+    `axis_name` may be a tuple (the 2-D mesh — ISSUE 15 generalizes
+    ShuffleBN to arbitrary mesh shapes); `chunks` applies the FAST-style
+    chunked gather schedule (see `all_gather_batch`).
     """
-    n = axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
-    x_all = all_gather_batch(x, axis_name)  # [B_global, ...]
+    n = batch_axis_size(axis_name)
+    idx = batch_axis_index(axis_name)
+    x_all = all_gather_batch(x, axis_name, chunks)  # [B_global, ...]
     global_b = x_all.shape[0]
     perm = jax.random.permutation(key, global_b)
     local_idx = lax.dynamic_slice_in_dim(perm, idx * (global_b // n), global_b // n)
     return jnp.take(x_all, local_idx, axis=0), perm
 
 
-def batch_unshuffle(x: jax.Array, perm: jax.Array, axis_name: str) -> jax.Array:
+def batch_unshuffle(x: jax.Array, perm: jax.Array, axis_name,
+                    chunks: int = 1) -> jax.Array:
     """Undo `batch_shuffle` (rebuild of `_batch_unshuffle_ddp`,
     `moco/builder.py:≈L100-115`): gather the shuffled global batch, index it
     with this device's slice of the inverse permutation."""
-    n = axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
-    x_all = all_gather_batch(x, axis_name)
+    n = batch_axis_size(axis_name)
+    idx = batch_axis_index(axis_name)
+    x_all = all_gather_batch(x, axis_name, chunks)
     global_b = x_all.shape[0]
     inv = jnp.argsort(perm)
     local_idx = lax.dynamic_slice_in_dim(inv, idx * (global_b // n), global_b // n)
@@ -95,8 +154,6 @@ def chained_psum(flats: list[jax.Array], axis_name: str) -> list[jax.Array]:
     the backward that produces bucket i+1 is still running (DeAR,
     PAPERS.md). On builds whose barrier is identity (utils/compat.py) the
     numerics are unchanged — only the scheduling hint is lost."""
-    from moco_tpu.utils.compat import optimization_barrier
-
     out = []
     prev = None
     for flat in flats:
@@ -167,7 +224,7 @@ def quantized_psum_mean(
     raise ValueError(f"unknown quantized wire dtype {wire_dtype!r}")
 
 
-def ring_shuffle(x: jax.Array, axis_name: str, inverse: bool = False) -> jax.Array:
+def ring_shuffle(x: jax.Array, axis_name, inverse: bool = False) -> jax.Array:
     """Cheaper ShuffleBN variant: HALF-SHARD ring roll via two `ppermute`s.
 
     Rotating WHOLE local batches would be a functional no-op for ShuffleBN —
@@ -179,9 +236,10 @@ def ring_shuffle(x: jax.Array, axis_name: str, inverse: bool = False) -> jax.Arr
     query group is split across two key groups — partial decorrelation at
     2 half-shard ppermutes instead of a full all-gather. The gather+permute
     `batch_shuffle` stays the semantically faithful default
-    (`shuffle_mode="permute"`).
+    (`shuffle_mode="permute"`). A tuple axis runs the ring over the
+    combined row-major device group (ISSUE 15 mesh generalization).
     """
-    n = axis_size(axis_name)
+    n = batch_axis_size(axis_name)
     if x.shape[0] % 2:
         raise ValueError("ring_shuffle requires an even local batch")
     h = x.shape[0] // 2
@@ -198,3 +256,39 @@ def ring_shuffle(x: jax.Array, axis_name: str, inverse: bool = False) -> jax.Arr
     back_tail = lax.ppermute(head, axis_name, [(i, (i - 2) % n) for i in range(n)])
     back_head = lax.ppermute(tail, axis_name, [(i, (i - 1) % n) for i in range(n)])
     return jnp.concatenate([back_head, back_tail], axis=0)
+
+
+def multihop_quantized_psum_mean(
+    segments: list[jax.Array],
+    inter_axis: str,
+    intra_axis: str,
+    n_inter: int,
+    n_intra: int,
+    wire_dtype: str,
+) -> tuple[list[jax.Array], list[jax.Array]]:
+    """DynamiQ-style topology-aware two-hop reduce (PAPERS.md; ISSUE 15).
+
+    Hop 1 — EXACT f32 psum over `intra_axis` (the fast intra-pod links:
+    compression there would spend accuracy where bandwidth is free).
+    Hop 2 — compress→psum→dequant over `inter_axis` (the slow inter-pod
+    links) through the SAME int8/bf16 machinery as the single-hop
+    `quantized_psum_mean`, so the shared-scale / int32-carrier invariants
+    carry over unchanged. Returns `(means, errors)` like the single-hop
+    reduce.
+
+    Error feedback across hops: quantization acts on the INTRA-SUMMED
+    value, which every member of an intra group shares — so the raw
+    residual is a per-GROUP quantity. Each device stores residual/n_intra:
+    next step every member re-injects its share into its local gradient,
+    and hop 1's exact sum reassembles the full residual, exactly once
+    (carrying the whole residual on every member would amplify it
+    n_intra-fold per step — a hidden positive feedback loop).
+    """
+    summed_intra = [lax.psum(s, intra_axis) for s in segments]
+    means, group_errs = quantized_psum_mean(
+        summed_intra, inter_axis, n_inter, wire_dtype
+    )
+    # the inter hop's mean divided by n_inter only; fold in the intra fan-in
+    means = [m / n_intra for m in means]
+    errs = [e / n_intra for e in group_errs]
+    return means, errs

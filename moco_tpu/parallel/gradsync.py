@@ -73,7 +73,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from moco_tpu.parallel.collectives import chained_psum, quantized_psum_mean
+from moco_tpu.parallel.collectives import (
+    chained_psum,
+    multihop_quantized_psum_mean,
+    quantized_psum_mean,
+)
 from moco_tpu.parallel.mesh import DATA_AXIS
 from moco_tpu.utils.compat import optimization_barrier
 
@@ -117,13 +121,36 @@ class GradSync:
         grads = gradsync.finalize(payload, step)
     """
 
-    def __init__(self, config, mesh_size: int):
+    def __init__(self, config, mesh_size: int, axes=None, axis_sizes=None):
         self.mode = getattr(config, "grad_sync", "fused")
         if self.mode not in GRAD_SYNC_MODES:
             raise ValueError(
                 f"unknown grad_sync {self.mode!r}; choose from {GRAD_SYNC_MODES}"
             )
         self.n = int(mesh_size)
+        # the mesh axes the reduce runs over (ISSUE 15): the 1-D data axis
+        # by default; the sharded step builders pass the 2-D mesh's
+        # (data, fsdp) with per-axis sizes. With BOTH axes > 1 the
+        # quantized mode becomes the DynamiQ-style multi-hop reduce:
+        # exact psum over the inner (fast, intra-pod) axis, int8/bf16
+        # compressed hop over the outer (slow, inter-pod) axis.
+        self.axes = tuple(axes) if axes else (DATA_AXIS,)
+        if axis_sizes is None:
+            axis_sizes = (self.n,) if len(self.axes) == 1 else None
+        if len(self.axes) > 1 and axis_sizes is None:
+            raise ValueError("multi-axis GradSync needs axis_sizes")
+        self.axis_sizes = tuple(int(s) for s in axis_sizes) if axis_sizes \
+            else (self.n,)
+        if math.prod(self.axis_sizes) != self.n:
+            raise ValueError(
+                f"axis_sizes {self.axis_sizes} do not multiply to the mesh "
+                f"size {self.n}"
+            )
+        self.multihop = (
+            self.mode == "quantized"
+            and len(self.axes) == 2
+            and all(s > 1 for s in self.axis_sizes)
+        )
         self.allreduce_dtype = getattr(config, "grad_allreduce_dtype", "float32")
         if self.mode in ("fused", "bucketed"):
             # validate at build time, not first trace
@@ -142,6 +169,20 @@ class GradSync:
         self.demo_beta = float(getattr(config, "grad_sync_demo_beta", 0.9))
         self._plans: list[_LeafPlan] | None = None
         self._treedef = None
+
+    @classmethod
+    def for_mesh(cls, config, mesh):
+        """The strategy bound to `mesh`'s OWN axes — the one constructor
+        every consumer of a possibly-2-D mesh must use (step builder,
+        driver telemetry, bench rows): a hand-rolled
+        `GradSync(config, mesh.size)` on a 2-D mesh would run/describe the
+        single-hop reduce while the step executes the multihop one, and
+        every byte claim built on it would drift from what P8 audits."""
+        axes = tuple(str(a) for a in mesh.axis_names)
+        if len(axes) == 1:
+            return cls(config, mesh.size)
+        return cls(config, mesh.size, axes=axes,
+                   axis_sizes=tuple(int(mesh.shape[a]) for a in axes))
 
     # -- planning (host-side, shapes only) ----------------------------------
     @property
@@ -211,13 +252,41 @@ class GradSync:
             info["buckets"] = len(self._buckets())
         if self.mode == "quantized":
             info["quant_dtype"] = self.quant_dtype
+        if self.multihop:
+            # per-hop wire accounting (ISSUE 15; progcheck P8 verifies the
+            # TOTAL against the traced program): the exact intra hop rides
+            # the fast axis, the compressed hop the slow one
+            info["multihop"] = {
+                "intra_axis": self.axes[1], "intra_size": self.axis_sizes[1],
+                "inter_axis": self.axes[0], "inter_size": self.axis_sizes[0],
+                "intra_bytes_per_step": self._hop_bytes("intra"),
+                "inter_bytes_per_step": self._hop_bytes("inter"),
+            }
         if self.mode == "demo":
             info["cadence"] = self.cadence
             info["topk"] = self.topk
         return info
 
+    def _hop_bytes(self, hop: str) -> int:
+        """Per-device wire bytes of one multihop-quantized hop: `intra` =
+        the exact f32 psum, `inter` = the compressed payload + scales."""
+        assert self.multihop and self._plans is not None
+        total = 0
+        for p in self._plans:
+            if not p.is_float:
+                continue  # exact-sum leaves ride the single combined psum
+            if hop == "intra":
+                total += p.size * 4
+            else:
+                total += p.size * (1 if self.quant_dtype == "int8" else 2)
+        if hop == "inter" and self.quant_dtype == "int8":
+            total += 4 * sum(1 for p in self._plans if p.is_float)
+        return total
+
     def sync_bytes_per_step(self) -> int:
-        """Analytic per-device wire payload per step (see `describe`)."""
+        """Analytic per-device wire payload per step (see `describe`).
+        Multihop quantized counts BOTH hops — the exact intra-pod psum is
+        wire traffic too, just on the fast axis."""
         assert self._plans is not None, "call plan()/describe() first"
         total = 0
         for p in self._plans:
@@ -225,6 +294,8 @@ class GradSync:
                 total += p.size * p.dtype.itemsize
             elif self.mode == "quantized":
                 total += p.size * (1 if self.quant_dtype == "int8" else 2)
+                if self.multihop:
+                    total += p.size * 4  # the exact intra-pod hop
             elif self.mode == "demo":
                 # (value f32 + index i32) per selected element, / cadence
                 total += int(p.k * 8 / self.cadence)
@@ -262,10 +333,18 @@ class GradSync:
     def payload_specs(self, P):
         """out_specs prefix for the region payload (`P` is PartitionSpec)."""
         if self.mode == "demo":
-            return {"vals": P(DATA_AXIS), "idx": P(DATA_AXIS), "exact": P()}
+            batch = self.reduce_axis
+            return {"vals": P(batch), "idx": P(batch), "exact": P()}
         return P()
 
-    def region_reduce(self, grads, gs_state, step, axis_name: str = DATA_AXIS):
+    @property
+    def reduce_axis(self):
+        """The axis-name argument the collectives take: the bare name on
+        the 1-D mesh (bit-compatible with the pre-ISSUE-15 jaxprs), the
+        tuple (one combined device group) on the 2-D mesh."""
+        return self.axes[0] if len(self.axes) == 1 else self.axes
+
+    def region_reduce(self, grads, gs_state, step, axis_name=None):
         """Reduce local grads inside the mapped region.
 
         Returns `(payload, new_gs_state, probe_pre)`:
@@ -278,6 +357,8 @@ class GradSync:
           — the "grads are ready" marker the comm-phase fence drains first
           (telemetry/timing.py).
         """
+        if axis_name is None:
+            axis_name = self.reduce_axis
         self.plan(grads)
         leaves = jax.tree.flatten(grads)[0]
         probe_pre = self._probe_pre(leaves, axis_name)
@@ -379,9 +460,18 @@ class GradSync:
                 # sequence the buckets like the bucketed mode: a
                 # deterministic issue order the scheduler can pipeline
                 segs, prev = optimization_barrier((segs, prev))
-            means, errs = quantized_psum_mean(
-                segs, axis_name, self.n, self.quant_dtype
-            )
+            if self.multihop:
+                # DynamiQ topology-aware path (2-D mesh, both axes > 1):
+                # exact on the fast inner axis, compressed on the slow
+                # outer one
+                means, errs = multihop_quantized_psum_mean(
+                    segs, self.axes[0], self.axes[1],
+                    self.axis_sizes[0], self.axis_sizes[1], self.quant_dtype,
+                )
+            else:
+                means, errs = quantized_psum_mean(
+                    segs, axis_name, self.n, self.quant_dtype
+                )
             prev = means[0]
             for p, mean, err in zip(bucket, means, errs):
                 out[p.index] = mean.reshape(p.shape).astype(p.dtype)
@@ -472,7 +562,7 @@ class GradSync:
             )
             return payload, new_state
 
-        state_spec = P(DATA_AXIS) if self.needs_state else P()
+        state_spec = P(self.reduce_axis) if self.needs_state else P()
         fn = shard_map(
             region, mesh=mesh,
             in_specs=(P(), state_spec, P()),

@@ -25,9 +25,19 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# The single mesh axis used by the whole framework. Batch dim is sharded over
-# it; params/queue/opt-state are replicated over it.
+# The primary mesh axis. On the 1-D data-parallel mesh (the seed layout)
+# the batch dim is sharded over it and params/queue/opt-state are
+# replicated. ISSUE 15 adds a second, FSDP axis: on the 2-D mesh the batch
+# shards over BOTH axes (data parallelism spans every device) while
+# params/optimizer state shard over the fsdp axis only — the fast
+# intra-pod axis on real hardware, so the per-step param all-gathers ride
+# ICI while the (optionally quantized) inter-pod grad hop rides DCN.
 DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+
+# PretrainConfig.sharding values (mirrored as literals in config.py, which
+# must stay importable without jax)
+SHARDING_MODES = ("dp", "fsdp", "fsdp_tp")
 
 
 def force_cpu_devices(n: int = 8) -> None:
@@ -99,14 +109,98 @@ def create_mesh(num_devices: int | None = None, devices: Sequence[jax.Device] | 
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
+def create_mesh_2d(
+    fsdp_size: int,
+    num_devices: int | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """The 2-D (data, fsdp) mesh (ISSUE 15). `fsdp_size` devices form each
+    param-shard group (the INNER, fast axis); the outer data axis carries
+    plain replica parallelism across groups. Device order is preserved
+    from the flat list, so a (1, N) mesh reduces over exactly the same
+    device sequence as the 1-D mesh — the bitwise-parity anchor the fsdp
+    tests pin."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices but only {len(devices)} present"
+            )
+        devices = devices[:num_devices]
+    n = len(devices)
+    if fsdp_size < 1 or n % fsdp_size != 0:
+        raise ValueError(
+            f"fsdp axis size {fsdp_size} must divide the device count {n}"
+        )
+    return Mesh(
+        np.asarray(devices).reshape(n // fsdp_size, fsdp_size),
+        (DATA_AXIS, FSDP_AXIS),
+    )
+
+
+def default_fsdp_size(sharding: str, n_devices: int) -> int:
+    """The fsdp-axis size a `sharding_axis_size=0` config resolves to:
+    all devices for pure fsdp; for fsdp_tp the largest proper divisor
+    (e.g. 4 devices → data 2 × fsdp 2, 8 → 2×4) — a placeholder for the
+    real intra-pod group size, which `sharding_axis_size` pins on
+    hardware whose topology is known."""
+    if sharding == "fsdp":
+        return n_devices
+    for d in range(n_devices // 2, 0, -1):
+        if n_devices % d == 0:
+            return d
+    return 1
+
+
+def mesh_for_config(config, mesh: Mesh | None = None,
+                    num_devices: int | None = None) -> Mesh:
+    """The mesh `config.sharding` needs, rebuilt from `mesh`'s own devices
+    when the provided one has the wrong axis set (the driver and tests
+    hand in the plain 1-D mesh; fsdp runs fold it into the 2-D layout
+    without changing the device order)."""
+    mode = getattr(config, "sharding", "dp")
+    devices = None
+    if mesh is not None:
+        devices = list(mesh.devices.flat)
+    if mode == "dp":
+        if mesh is not None and tuple(mesh.axis_names) == (DATA_AXIS,):
+            return mesh
+        return create_mesh(num_devices, devices=devices)
+    n = len(devices) if devices is not None else len(
+        jax.devices()[:num_devices] if num_devices else jax.devices())
+    fsdp_size = int(getattr(config, "sharding_axis_size", 0)) or \
+        default_fsdp_size(mode, n)
+    if mode == "fsdp" and fsdp_size != n:
+        raise ValueError(
+            f"sharding='fsdp' shards over ALL {n} devices; "
+            f"sharding_axis_size={fsdp_size} asks for a sub-group — that "
+            "is the fsdp_tp hybrid, say so explicitly"
+        )
+    if (mesh is not None
+            and tuple(mesh.axis_names) == (DATA_AXIS, FSDP_AXIS)
+            and mesh.shape[FSDP_AXIS] == fsdp_size):
+        return mesh
+    return create_mesh_2d(fsdp_size, num_devices, devices=devices)
+
+
+def batch_axes(mesh: Mesh) -> tuple[str, ...]:
+    """The mesh axes the global batch shards over — ALL of them: on the
+    2-D mesh data parallelism spans every device, the fsdp axis only
+    changes where params live."""
+    return tuple(str(a) for a in mesh.axis_names)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Sharding for replicated state (params, queue, opt state)."""
     return NamedSharding(mesh, P())
 
 
 def batch_sharded(mesh: Mesh) -> NamedSharding:
-    """Sharding for a batch: leading dim split over the data axis."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+    """Sharding for a batch: leading dim split over every mesh axis (the
+    1-D data axis, or data×fsdp on the 2-D mesh — same global batch
+    semantics either way)."""
+    return NamedSharding(mesh, P(batch_axes(mesh)))
 
 
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:

@@ -73,9 +73,12 @@ def pdevice_state_shardings(tree, mesh):
     axis so each device holds exactly its own `[1, ...]` slice — the same
     footprint-per-chip argument as the ZeRO layout above, except here the
     split axis is semantic (slice i IS device i's state), not just a
-    partitioning choice."""
+    partitioning choice. On the 2-D data×fsdp mesh (ISSUE 15) the leading
+    axis splits over BOTH axes — n_dev is still the total device count and
+    slice i is still device i's state, in the mesh's row-major order."""
     replicated = NamedSharding(mesh, P())
-    sharded = NamedSharding(mesh, P(DATA_AXIS))
+    sharded = NamedSharding(
+        mesh, P(tuple(str(a) for a in mesh.axis_names)))
 
     def spec(leaf):
         shape = getattr(leaf, "shape", ())

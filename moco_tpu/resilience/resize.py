@@ -72,6 +72,11 @@ class ResizeRequest:
 
     devices: int | None = None
     grad_sync_cadence: int | None = None
+    sharding: str | None = None  # ISSUE 15: switch the sharding mode on
+                                 # relaunch (dp/fsdp/fsdp_tp) — e.g. a
+                                 # grow onto a pod flips dp→fsdp in the
+                                 # same resize; the dialect-3 restore +
+                                 # sidecar stamp make the mode hop safe
     slow: bool = False           # new mesh flagged slow-linked: the
                                  # supervisor applies its configured
                                  # cadence override
@@ -100,12 +105,17 @@ def parse_resize_request(text: str, source: str = "request") -> ResizeRequest:
             if req.grad_sync_cadence < 1:
                 raise ValueError(
                     f"resize grad_sync_cadence must be >= 1, got {value}")
+        elif key == "sharding":
+            if value not in ("dp", "fsdp", "fsdp_tp"):
+                raise ValueError(
+                    f"resize sharding must be dp/fsdp/fsdp_tp, got {value!r}")
+            req.sharding = value
         elif key == "slow":
             req.slow = bool(int(value))
         else:
             raise ValueError(
                 f"unknown resize request key {key!r}; known: devices, "
-                "grad_sync_cadence, slow"
+                "grad_sync_cadence, sharding, slow"
             )
     return req
 
@@ -119,6 +129,7 @@ def write_resize_request(
     devices: int | None = None,
     grad_sync_cadence: int | None = None,
     slow: bool = False,
+    sharding: str | None = None,
 ) -> str:
     """Drop a resize request next to trace.trigger (atomic: a supervisor
     polling mid-write must never parse half a request). Returns the path."""
@@ -127,6 +138,8 @@ def write_resize_request(
         parts.append(f"devices={int(devices)}")
     if grad_sync_cadence is not None:
         parts.append(f"grad_sync_cadence={int(grad_sync_cadence)}")
+    if sharding is not None:
+        parts.append(f"sharding={sharding}")
     if slow:
         parts.append("slow=1")
     os.makedirs(telemetry_dir, exist_ok=True)
@@ -448,6 +461,13 @@ class ResizeController:
         if cadence is not None:
             argv += ["--grad-sync-cadence", str(int(cadence))]
             summary["grad_sync_cadence"] = int(cadence)
+        if req.sharding is not None:
+            # ISSUE 15: the sharding mode rides the same last-wins append —
+            # an argv that already says --sharding fsdp keeps saying it on
+            # a mode-less resize (nothing appended), and a mode-carrying
+            # request flips it for the relaunch
+            argv += ["--sharding", req.sharding]
+            summary["sharding"] = req.sharding
         if self.rotate_cache and not env.get("MOCO_TPU_NO_CACHE"):
             from moco_tpu.utils.cache import per_run_cache_dir  # stdlib-only
 

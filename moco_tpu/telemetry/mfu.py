@@ -176,17 +176,24 @@ class MFUEstimator:
     fabricate a denominator."""
 
     def __init__(self, flops_per_step: float, n_chips: int,
-                 peak_flops_per_chip: float | None):
+                 peak_flops_per_chip: float | None, sharding: str = "dp"):
         self.flops_per_step = float(flops_per_step)
         self.n_chips = max(int(n_chips), 1)
         self.peak_flops_per_chip = (
             float(peak_flops_per_chip) if peak_flops_per_chip else None
         )
+        # the sharding mode the MFU is reported under (ISSUE 15): the
+        # analytic FLOPs are layout-invariant — fsdp changes per-device
+        # PARAM BYTES (the telemetry `sharding` event carries the measured
+        # inventory) and the collective schedule, never the model math —
+        # so the estimator carries the label rather than a different count
+        self.sharding = sharding
 
     @classmethod
     def for_config(cls, config, n_chips: int, device_kind: str = ""):
         peak = config.peak_flops_per_chip or detect_peak_flops(device_kind)
-        return cls(train_step_flops(config), n_chips, peak)
+        return cls(train_step_flops(config), n_chips, peak,
+                   sharding=getattr(config, "sharding", "dp"))
 
     def mfu(self, step_s: float) -> float | None:
         if not self.peak_flops_per_chip or step_s <= 0:

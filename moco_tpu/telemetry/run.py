@@ -118,6 +118,7 @@ class RunTelemetry:
             steps_per_epoch=steps_per_epoch,
             n_chips=n_chips,
             n_procs=n_procs,
+            sharding=getattr(config, "sharding", "dp"),
             device_kind=getattr(device, "device_kind", ""),
             peak_flops_per_chip=self.mfu.peak_flops_per_chip,
             flops_per_step=self.mfu.flops_per_step,
@@ -144,6 +145,14 @@ class RunTelemetry:
         about the bytes its step times were measured under."""
         self._grad_sync = dict(info)
         self.registry.emit("event", event="grad_sync", **info)
+
+    def set_sharding(self, info: dict) -> None:
+        """Record the sharding plan (ISSUE 15): mode, mesh shape, measured
+        per-device param/optimizer bytes. One routine `sharding` event —
+        the per-device footprint claim every "fsdp cuts state N-fold" row
+        in a BENCH record rests on; telemetry_report renders it as the
+        `sharding:` line and MFU is thereby labeled per mode."""
+        self.registry.emit("event", event="sharding", **info)
 
     def phase_beat(self, phase: str, step: int) -> None:
         """Forced heartbeat declaring a known-long non-step phase (the

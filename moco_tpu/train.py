@@ -207,6 +207,13 @@ def train(config: PretrainConfig, mesh=None, max_steps: int | None = None,
     """
     if mesh is None:
         mesh = create_mesh()
+    # ISSUE 15: fsdp runs need the 2-D (data, fsdp) mesh; callers (tests,
+    # main) hand in the plain 1-D mesh and this folds it — same devices,
+    # same order — into the layout config.sharding asks for. dp passes
+    # through untouched.
+    from moco_tpu.parallel.mesh import mesh_for_config
+
+    mesh = mesh_for_config(config, mesh)
     installed_chaos = False
     if config.chaos:
         if active_chaos() is None:
@@ -441,28 +448,81 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
     # fused/bucketed attach an empty tree
     from moco_tpu.parallel.gradsync import GradSync
 
-    gradsync = GradSync(config, mesh.size)
+    # bound to the mesh's own axes (for_mesh): on the 2-D fsdp_tp mesh the
+    # quantized reduce is the multihop one, and the telemetry describe()
+    # below must account the same per-hop bytes the program moves
+    gradsync = GradSync.for_mesh(config, mesh)
     state = gradsync.attach(state, mesh)
-    step_fn = build_train_step(config, model, tx, mesh, steps_per_epoch, sched)
+    if config.sharding != "dp":
+        # FSDP placement (ISSUE 15): params/opt leaves land sharded over
+        # the fsdp axis BEFORE the step builds, so jit compiles against
+        # the committed input shardings (the zero_sharding pattern)
+        from moco_tpu.parallel import fsdp
+
+        state = fsdp.place_state(state, mesh, config)
+    step_fn = build_train_step(config, model, tx, mesh, steps_per_epoch,
+                               sched, state=state)
     if telemetry is not None:
         # static comm facts for the record stream: mode, knobs, analytic
-        # per-device sync payload (bytes/step) — rendered by telemetry_report
-        telemetry.set_grad_sync(gradsync.describe(state.params_q))
+        # per-device sync payload (bytes/step) — rendered by
+        # telemetry_report. `sharding` stamps the mode the numbers were
+        # measured under (ISSUE 15 satellite).
+        telemetry.set_grad_sync(
+            dict(gradsync.describe(state.params_q),
+                 sharding=config.sharding))
+        # per-device state inventory: under fsdp the params/opt bytes
+        # measure ~1/N of dp — the acceptance gate and bench read this
+        from moco_tpu.parallel.fsdp import state_bytes_per_device
+
+        telemetry.set_sharding(dict(
+            mode=config.sharding,
+            mesh_shape={str(a): int(s) for a, s in mesh.shape.items()},
+            **state_bytes_per_device(state),
+        ))
 
     mgr = checkpoint_manager(config.ckpt_dir) if config.ckpt_dir else None
     if mgr is not None and config.resume:
-        # restore straight into the mesh-replicated sharding: Orbax places
+        # restore straight into the run's own placement: Orbax places
         # every host's shards locally (a restore-then-`device_put` would
         # need cross-host transfers, unsupported on multi-process CPU and a
-        # DCN round-trip on real pods)
+        # DCN round-trip on real pods). dp restores replicated; fsdp passes
+        # the per-leaf NamedSharding TREE (dialect 3) so dp→fsdp and N→M
+        # checkpoints land sharded without a resharding pass.
         from moco_tpu.parallel.mesh import replicated
 
-        state = maybe_resume(mgr, state, config.resume, sharding=replicated(mesh))
+        if config.sharding != "dp":
+            from moco_tpu.parallel.fsdp import state_shardings
+
+            restore_sharding = state_shardings(state, mesh, config)
+        else:
+            restore_sharding = replicated(mesh)
+        state = maybe_resume(mgr, state, config.resume,
+                             sharding=restore_sharding)
         if gradsync.needs_state:
-            # re-place the per-device accumulators (the restore above lands
-            # them replicated) — mirrors the ZeRO re-shard below
+            # re-place the per-device accumulators (the replicated-restore
+            # path lands them replicated) — mirrors the ZeRO re-shard below
             state = state.replace(
                 gradsync=gradsync.place_state(state.gradsync, mesh))
+            # sharding-MODE change (ISSUE 15): at equal mesh size the
+            # accumulator shapes match, so the dialect shim cannot see it —
+            # but the EF residuals were accumulated under a different
+            # reduce topology. The sidecar stamp is the tiebreaker.
+            resumed_step = int(state.step)
+            if resumed_step:
+                from moco_tpu.checkpoint import read_recorded_sharding
+
+                recorded = read_recorded_sharding(
+                    config.ckpt_dir, resumed_step) or "dp"
+                if recorded != config.sharding:
+                    log_event(
+                        "ckpt-dialect",
+                        f"step {resumed_step} was saved under sharding="
+                        f"{recorded!r}, this run uses {config.sharding!r} — "
+                        "discarding its gradsync accumulators: error-"
+                        "feedback/momentum state restarts from zeros",
+                    )
+                    state = state.replace(gradsync=jax.tree.map(
+                        jnp.zeros_like, state.gradsync))
     if config.zero_sharding:
         # ZeRO-1 (after any resume, so the placement survives it): optimizer
         # state sharded over the data axis; jit propagates the committed
@@ -990,7 +1050,8 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
                 # next epoch's compute; the integrity manifest is deferred to
                 # the next save / finalize_checkpoints
                 save_checkpoint(mgr, state, global_step, wait=False,
-                                position=(epoch + 1, 0), devices=n_chips)
+                                position=(epoch + 1, 0), devices=n_chips,
+                                sharding=config.sharding)
         if sentinel is not None:
             # the final step's loss is still pending (one-step lag)
             sentinel.flush()
@@ -1030,7 +1091,7 @@ def _train_once_impl(config: PretrainConfig, mesh, max_steps: int | None = None,
             step=global_step, pid=os.getpid(),
         )
         save_checkpoint(mgr, state, global_step, position=emergency_pos,
-                        devices=n_chips)
+                        devices=n_chips, sharding=config.sharding)
     if preempted:
         # surfaced to callers (absent otherwise): main() turns it into
         # EXIT_PREEMPTED so the supervisor can tell a preemption's clean
@@ -1120,12 +1181,20 @@ def main(argv=None):
 
     enable_persistent_cache()
     try:
-        mesh = create_mesh(args.num_devices)
+        # fold in the config's sharding layout HERE so an unsatisfiable
+        # combination (--sharding-axis-size not dividing the device count,
+        # a resize-appended --sharding onto the wrong mesh) exits
+        # config_error like any other bad argv — train()'s own re-fold is
+        # then a no-op for the CLI path
+        from moco_tpu.parallel.mesh import mesh_for_config
+
+        mesh = mesh_for_config(config, create_mesh(args.num_devices))
     except ValueError as e:
         # more devices requested than exist (e.g. a typo'd resize request's
-        # --num-devices append): the same argv can never succeed — the
-        # supervisor must classify this config_error and revert/stop, not
-        # relaunch a generic "crash" into a loop
+        # --num-devices append), or a sharding layout the device count
+        # cannot satisfy: the same argv can never succeed — the supervisor
+        # must classify this config_error and revert/stop, not relaunch a
+        # generic "crash" into a loop
         log_event("exit", f"mesh config error: {e}", code=EXIT_CONFIG_ERROR)
         sys.exit(EXIT_CONFIG_ERROR)
     info(f"config: {config}")

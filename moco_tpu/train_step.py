@@ -189,13 +189,15 @@ def _build_key_path(config: PretrainConfig, model):
     encoder) is the SAME code the train step traces — deleting the
     stop_gradient here changes both, and the auditor fires."""
 
+    chunks = int(getattr(config, "collective_chunks", 1))
+
     def key_path(params_k, stats_k, im_k, key):
         if config.shuffle_mode == "ring":
             from moco_tpu.parallel.collectives import ring_shuffle
 
             im_k_shuf = ring_shuffle(im_k, DATA_AXIS)
         else:
-            im_k_shuf, perm = batch_shuffle(im_k, key, DATA_AXIS)
+            im_k_shuf, perm = batch_shuffle(im_k, key, DATA_AXIS, chunks)
         k, mut_k = model.apply(
             {"params": params_k, "batch_stats": stats_k},
             im_k_shuf,
@@ -206,7 +208,7 @@ def _build_key_path(config: PretrainConfig, model):
         if config.shuffle_mode == "ring":
             k = ring_shuffle(k, DATA_AXIS, inverse=True)
         else:
-            k = batch_unshuffle(k, perm, DATA_AXIS)
+            k = batch_unshuffle(k, perm, DATA_AXIS, chunks)
         k = lax.stop_gradient(k)  # the reference's no_grad key path
         return k, mut_k["batch_stats"]
 
@@ -276,7 +278,8 @@ def build_grad_probe(config: PretrainConfig, model, mesh):
     )
 
 
-def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: int, sched=None):
+def build_train_step(config: PretrainConfig, model, tx, mesh,
+                     steps_per_epoch: int, sched=None, state=None):
     """Return jitted `(state, im_q, im_k) -> (state', metrics)`, state donated.
 
     `im_q`/`im_k` are GLOBAL `[B, H, W, C]` batches (sharded over the data
@@ -286,13 +289,18 @@ def build_train_step(config: PretrainConfig, model, tx, mesh, steps_per_epoch: i
     `steps_per_epoch` — pass it through so the logged `metrics['lr']` is by
     construction the lr optax applies. If omitted it is re-derived here with
     this call's `steps_per_epoch`.
+
+    `state` (an example TrainState; abstract shapes suffice) is required
+    only for the FSDP-sharded v3 step (ISSUE 15) — the per-leaf shard axes
+    are fixed from its shapes at build time.
     """
     if config.shuffle_mode not in ("permute", "ring"):
         raise ValueError(f"unknown shuffle_mode {config.shuffle_mode!r}")
     if config.variant == "v3":
         from moco_tpu.v3_step import build_v3_train_step
 
-        return build_v3_train_step(config, model, tx, mesh, steps_per_epoch, sched)
+        return build_v3_train_step(config, model, tx, mesh, steps_per_epoch,
+                                   sched, state)
 
     temperature = config.temperature
     total_steps = config.epochs * steps_per_epoch

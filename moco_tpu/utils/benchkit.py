@@ -71,8 +71,15 @@ def build_v2_fused_step(config, mesh, *, steps_per_epoch: int = 1000,
     # them — a quantized/demo bench without the state would crash at trace
     from moco_tpu.parallel.gradsync import GradSync
 
-    state = GradSync(config, n_chips).attach(state, mesh)
-    step_fn = build_train_step(config, model, tx, mesh, steps_per_epoch, sched)
+    state = GradSync.for_mesh(config, mesh).attach(state, mesh)
+    if getattr(config, "sharding", "dp") != "dp":
+        # FSDP placement (ISSUE 15), exactly as the driver applies it —
+        # the sharded bench must time the sharded program
+        from moco_tpu.parallel import fsdp
+
+        state = fsdp.place_state(state, mesh, config)
+    step_fn = build_train_step(config, model, tx, mesh, steps_per_epoch,
+                               sched, state=state)
     # the SAME variant->aug selection as the train driver (v1 presets get
     # the v1 recipe, not a silently-substituted v2 stack — review, r5)
     aug_cfg = with_dtype(aug_config_for(config), config.compute_dtype)

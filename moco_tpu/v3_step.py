@@ -134,16 +134,19 @@ def _build_momentum_keys(model: V3Model):
     return momentum_keys
 
 
-def _build_query_loss(model: V3Model, temperature: float):
+def _build_query_loss(model: V3Model, temperature: float,
+                      batch_axis=DATA_AXIS, chunks: int = 1):
     """The symmetric v3 contrastive core, shared by the spmd_region's
-    value_and_grad and the grad-flow probe."""
+    value_and_grad and the grad-flow probe. `batch_axis` is the data axis
+    (or the 2-D mesh's axis tuple — ISSUE 15); `chunks` routes the key
+    gathers through the FAST-style chunked schedule."""
     apply = _build_apply(model)
 
     def query_loss(pq, stats_q, x1, x2, k1, k2):
         q1, s = apply(pq, stats_q, x1, predict=True)
         q2, s = apply(pq, s, x2, predict=True)
-        loss = v3_contrastive_loss(q1, k2, temperature, DATA_AXIS) + \
-               v3_contrastive_loss(q2, k1, temperature, DATA_AXIS)
+        loss = v3_contrastive_loss(q1, k2, temperature, batch_axis, chunks) + \
+               v3_contrastive_loss(q2, k1, temperature, batch_axis, chunks)
         return loss, (s, q1)
 
     return query_loss
@@ -184,9 +187,20 @@ def build_v3_grad_probe(config: PretrainConfig, model: V3Model, mesh):
 
 
 def build_v3_train_step(
-    config: PretrainConfig, model: V3Model, tx, mesh, steps_per_epoch: int, sched=None
+    config: PretrainConfig, model: V3Model, tx, mesh, steps_per_epoch: int,
+    sched=None, state=None,
 ):
-    """Jitted `(state, x1, x2) -> (state', metrics)`, state donated."""
+    """Jitted `(state, x1, x2) -> (state', metrics)`, state donated.
+
+    With `config.sharding != "dp"` (ISSUE 15) the step is FSDP-sharded:
+    `state` (an example TrainState — abstract shapes suffice) is required
+    so the per-leaf shard axes are fixed at build time; params enter the
+    region as fsdp shards, are all-gathered on use, and the GradSync-
+    reduced gradient is sliced back to the shard before it leaves the
+    region. The dp path is byte-for-byte the pre-ISSUE-15 program.
+    """
+    from moco_tpu.parallel.collectives import batch_axis_index
+    from moco_tpu.parallel.fsdp import plan_for
     from moco_tpu.parallel.gradsync import GradSync
     from moco_tpu.train_step import lr_schedule
 
@@ -194,12 +208,34 @@ def build_v3_train_step(
     total_steps = config.epochs * steps_per_epoch
     if sched is None:
         sched = lr_schedule(config, steps_per_epoch)
-    gradsync = GradSync(config, mesh.size)
+    plan = plan_for(config, mesh)
+    if plan is None:
+        batch_axis = DATA_AXIS
+        gradsync = GradSync(config, mesh.size)
+    else:
+        if state is None:
+            raise ValueError(
+                f"sharding={config.sharding!r} needs the example `state` at "
+                "step-build time (the per-leaf shard axes come from its "
+                "shapes) — the driver passes the freshly-created TrainState"
+            )
+        batch_axis = plan.batch_axes
+        gradsync = GradSync.for_mesh(config, mesh)
+        q_axes = plan.axis_tree(state.params_q)
+        k_axes = plan.axis_tree(state.params_k)
+        q_specs = plan.specs(state.params_q)
+        k_specs = plan.specs(state.params_k)
+    chunks = int(getattr(config, "collective_chunks", 1))
     momentum_keys = _build_momentum_keys(model)
-    query_loss = _build_query_loss(model, temperature)
+    query_loss = _build_query_loss(model, temperature, batch_axis, chunks)
 
     def spmd_region(params_q, params_k, stats_q, stats_k, gs_state, x1, x2,
                     step):
+        if plan is not None:
+            # all-gather-on-use: the full weights exist only inside the
+            # region's forward/backward window
+            params_q = plan.gather(params_q, q_axes)
+            params_k = plan.gather(params_k, k_axes)
         k1, k2, stats_k = momentum_keys(params_k, stats_k, x1, x2)
 
         def loss_fn(pq):
@@ -209,12 +245,16 @@ def build_v3_train_step(
             loss_fn, has_aux=True
         )(params_q)
         payload, gs_new, gs_probe = gradsync.region_reduce(grads, gs_state, step)
-        new_stats_q = lax.pmean(new_stats_q, DATA_AXIS)
-        new_stats_k = lax.pmean(stats_k, DATA_AXIS)
+        if plan is not None and gradsync.mode != "demo":
+            # reduce-scatter: the reduced full grads leave the region as
+            # this device's shard (demo's sparse payload merges outside)
+            payload = plan.scatter(payload, q_axes)
+        new_stats_q = lax.pmean(new_stats_q, batch_axis)
+        new_stats_k = lax.pmean(stats_k, batch_axis)
         # monitoring: in-batch top-1 for the q1·k2 direction
-        k2_all = all_gather_batch(k2, DATA_AXIS)
+        k2_all = all_gather_batch(k2, batch_axis, chunks)
         logits = jnp.einsum("nc,mc->nm", q1, k2_all, preferred_element_type=jnp.float32)
-        labels = jnp.arange(q1.shape[0]) + lax.axis_index(DATA_AXIS) * q1.shape[0]
+        labels = jnp.arange(q1.shape[0]) + batch_axis_index(batch_axis) * q1.shape[0]
         acc1 = 100.0 * jnp.mean(jnp.argmax(logits, axis=-1) == labels)
         # positive-pair alignment, same frozen-encoder detector as the
         # v1/v2 step's pos_sim (q1/k2 are L2-normalized, so the row-dot is
@@ -230,15 +270,26 @@ def build_v3_train_step(
             # stats) riding the SAME metrics pmean — no new collectives
             metrics.update(health.region_health(
                 q1, k2, grads, step, config.health_stride))
-        metrics = lax.pmean(metrics, DATA_AXIS)
+        metrics = lax.pmean(metrics, batch_axis)
         return payload, gs_new, gs_probe, new_stats_q, new_stats_k, metrics
 
+    if plan is None:
+        in_specs = (P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS),
+                    P(DATA_AXIS), P())
+        out_specs = (gradsync.payload_specs(P), P(DATA_AXIS), P(), P(), P(),
+                     P())
+    else:
+        batch_spec = P(plan.batch_axes)
+        payload_spec = (gradsync.payload_specs(P)
+                        if gradsync.mode == "demo" else q_specs)
+        in_specs = (q_specs, k_specs, P(), P(), batch_spec, batch_spec,
+                    batch_spec, P())
+        out_specs = (payload_spec, batch_spec, P(), P(), P(), P())
     region = shard_map(
         spmd_region,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-                  P()),
-        out_specs=(gradsync.payload_specs(P), P(DATA_AXIS), P(), P(), P(), P()),
+        in_specs=in_specs,
+        out_specs=out_specs,
     )
 
     def train_step(state: TrainState, x1, x2):

@@ -85,6 +85,28 @@ def test_flatten_service_and_prestage_rows_gate_detail_excluded():
     assert flat == {"m_e2e": 50.0}
 
 
+def test_flatten_sharding_rows_gate_by_name():
+    """ISSUE 15: the step child's per-sharding-mode v3 rows gate under
+    their own metric names; degraded rows (skipped/error) fold to
+    nothing instead of poisoning the gate."""
+    rec = {"metric": "m_step", "value": 100.0,
+           "sharding": {
+               "dp": {"imgs_per_sec_per_chip": 12.5,
+                      "state_bytes_per_device": 513544},
+               "fsdp": {"imgs_per_sec_per_chip": 11.0,
+                        "state_bytes_per_device": 128392},
+               "fsdp_tp": {"skipped": "sweep budget exhausted"},
+           }}
+    flat, _ = flatten(_wrapper(parsed=rec, tail_records=[rec]))
+    assert flat == {"m_step": 100.0,
+                    "m_step/sharding/dp": 12.5,
+                    "m_step/sharding/fsdp": 11.0}
+    # the rows gate like any named metric: a slower fresh fsdp row fails
+    verdict = gate_record({"m_step/sharding/fsdp": 8.0}, [("r1", flat)])
+    assert [r["metric"] for r in verdict["regressions"]] == [
+        "m_step/sharding/fsdp"]
+
+
 def test_flatten_takes_last_record_per_metric_and_skips_garbage():
     text = "\n".join([
         "not json",

@@ -39,6 +39,39 @@ def rules_of(findings):
     return [f.rule for f in findings]
 
 
+# -- R7: the fsdp extension (ISSUE 15) --------------------------------------
+
+
+def test_r7_flags_param_gather_scatter_outside_parallel(tmp_path):
+    """ISSUE 15: inline all_gather/psum_scatter on param-named operands
+    outside parallel/ bypasses the ShardingPlan's per-leaf bookkeeping;
+    gathers on non-param values (keys, batches) stay legal."""
+    findings = run_on(
+        tmp_path, "moco_tpu/stepish.py",
+        "from jax import lax\n"
+        "def region(params_q, k2, grads):\n"
+        "    full = lax.all_gather(params_q, 'fsdp')\n"      # violation
+        "    shard = lax.psum_scatter(grads, 'fsdp')\n"      # violation
+        "    keys = lax.all_gather(k2, 'data')\n"            # legal
+        "    return full, shard, keys\n",
+        select=("R7",),
+    )
+    assert rules_of(findings) == ["R7", "R7"]
+    assert any("ShardingPlan" in f.message for f in findings)
+    assert any("gradsync API" in f.message for f in findings)
+
+
+def test_r7_allows_param_gather_under_parallel(tmp_path):
+    findings = run_on(
+        tmp_path, "moco_tpu/parallel/fsdpish.py",
+        "from jax import lax\n"
+        "def gather(params):\n"
+        "    return lax.all_gather(params, 'fsdp')\n",
+        select=("R7",),
+    )
+    assert findings == []
+
+
 # -- R8: host syncs in traced step code -------------------------------------
 
 R8_POSITIVE = """\

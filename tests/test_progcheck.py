@@ -128,7 +128,7 @@ def test_p1_fires_when_v3_momentum_stop_gradient_removed(mesh8, monkeypatch):
 
         return momentum_keys
 
-    def broken_v3_loss(q, k, temperature, axis_name):
+    def broken_v3_loss(q, k, temperature, axis_name, chunks=1):
         # v3_contrastive_loss minus its own `k = stop_gradient(k)`
         from moco_tpu.parallel.collectives import all_gather_batch
 
@@ -311,8 +311,10 @@ def test_p7_flags_unaliasable_donation():
 
 
 def test_p8_clean_on_all_modes(gradsync_records):
+    # "quantized@2d" (ISSUE 15) is the DynamiQ multi-hop reduce over the
+    # 2-D mesh — P8 verifies its per-hop bytes sum to the analytic claim
     assert sorted(r.mode for r in gradsync_records) == [
-        "bucketed", "demo", "fused", "quantized"]
+        "bucketed", "demo", "fused", "quantized", "quantized@2d"]
     for rec in gradsync_records:
         assert _run(rec, "P8") == [], rec.name
 
@@ -440,7 +442,7 @@ def test_repo_gate_full_surface_clean_within_budget(tmp_path):
     summary = report.fold_programs({"steps": 0}, inv)
     assert summary["programs"]["count"] == inv["program_count"]
     assert set(summary["programs"]["gradsync_bytes_per_step"]) == {
-        "fused", "bucketed", "quantized", "demo"}
+        "fused", "bucketed", "quantized", "demo", "quantized@2d"}
     cross = summary["programs"].get("mfu_cross_check", [])
     assert cross, "no mfu_cross_check rows (cost_analysis unavailable?)"
     # v1 proxy: the backbone the analytic model counts IS the program's
@@ -456,7 +458,7 @@ def test_repo_gate_full_surface_clean_within_budget(tmp_path):
 
 def test_inventory_json_shape(gradsync_records, mesh8):
     inv = inventory_json(gradsync_records, mesh8.size)
-    assert inv["version"] == 1 and inv["by_family"] == {"gradsync": 4}
+    assert inv["version"] == 1 and inv["by_family"] == {"gradsync": 5}
     rec = inv["programs"][0]
     assert {"name", "family", "collectives", "collective_bytes",
             "in_avals"} <= set(rec)

@@ -74,6 +74,39 @@ def test_parse_resize_request_forms():
         parse_resize_request("devices=0")
     with pytest.raises(ValueError, match="key=value"):
         parse_resize_request("devices")
+    # ISSUE 15: a resize can flip the sharding mode for the relaunch
+    assert parse_resize_request("devices=8 sharding=fsdp").sharding == "fsdp"
+    assert parse_resize_request("devices=2").sharding is None
+    with pytest.raises(ValueError, match="sharding"):
+        parse_resize_request("sharding=zero3")
+
+
+def test_resize_apply_carries_sharding_mode(tmp_path):
+    """The relaunch argv carries the requested sharding mode (argparse
+    last-wins append, like the device count) — a grow onto a pod can flip
+    dp→fsdp in the same resize."""
+    d = str(tmp_path)
+    ctl = ResizeController(d)
+    write_resize_request(d, devices=8, sharding="fsdp")
+    req = ctl.poll()
+    assert req is not None and req.sharding == "fsdp"
+    req = ctl.take()  # the child exited EXIT_RESIZE; claim + disarm
+    argv = ["python", "-m", "moco_tpu.train", "--fake-devices", "1"]
+    env = {}
+    summary = ctl.apply(req, argv, env)
+    assert argv[-4:] == ["--fake-devices", "8", "--sharding", "fsdp"]
+    assert summary["sharding"] == "fsdp"
+    # a mode-less request appends nothing: the original argv's own
+    # --sharding (if any) keeps winning
+    write_resize_request(d, devices=2)
+    req2 = ctl.poll(now=time.monotonic() + 1.0)  # past the poll gate
+    assert req2 is not None
+    req2 = ctl.take()
+    argv2 = ["python", "-m", "moco_tpu.train", "--sharding", "fsdp",
+             "--fake-devices", "8"]
+    ctl.apply(req2, argv2, env)
+    assert "--sharding" not in argv2[-2:]
+    assert argv2.count("--sharding") == 1
 
 
 def test_request_claimed_exactly_once(tmp_path):

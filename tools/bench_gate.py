@@ -154,6 +154,17 @@ def flatten(source) -> tuple[dict, int]:
             v = ho.get("overhead_pct_of_step_p50")
             if isinstance(v, (int, float)) and v >= 0:
                 flat[f"{name}/health_overhead_pct"] = float(v)
+        sh = rec.get("sharding")
+        if isinstance(sh, dict):
+            # per-sharding-mode v3 rows (ISSUE 15): each mode gates under
+            # its own metric name once a round carries it — skipped/error
+            # rows (degraded sweep) carry no number and fold to nothing
+            for mode, row in sorted(sh.items()):
+                if not isinstance(row, dict):
+                    continue
+                v = row.get("imgs_per_sec_per_chip")
+                if isinstance(v, (int, float)) and v > 0:
+                    flat[f"{name}/sharding/{mode}"] = float(v)
     return flat, details
 
 

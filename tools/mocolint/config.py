@@ -180,6 +180,9 @@ STEP_BUILDER_MODULES = (
     "moco_tpu/data/augment.py",
     "moco_tpu/telemetry/health.py",  # ISSUE 13: traced into the step —
                                      # a host sync here stalls EVERY step
+    "moco_tpu/parallel/fsdp.py",     # ISSUE 15: gather/scatter trace into
+                                     # the sharded step (R9 already covers
+                                     # it via the parallel/ dir pattern)
 )
 
 DEFAULT_CONFIG = LintConfig(

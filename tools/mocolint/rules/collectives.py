@@ -1,9 +1,13 @@
-"""R7: gradient collectives live in moco_tpu/parallel/ only.
+"""R7: gradient/parameter collectives live in moco_tpu/parallel/ only.
 
 An inline `lax.pmean(grads, ...)` in a step builder silently reverts the
 step to the fused end-of-step reduce, bypassing the configured
 bucketing/quantization/sparsification AND the comm telemetry measuring
-it. Name-based on purpose: the lint guards the obvious regression, not
+it. ISSUE 15 widens the same contract to the FSDP primitives: an inline
+`all_gather(params, ...)` / `psum_scatter(grads, ...)` outside parallel/
+bypasses the ShardingPlan's per-leaf axis bookkeeping (gather and scatter
+MUST agree leaf-by-leaf) and the multihop/chunked scheduling layered on
+top. Name-based on purpose: the lint guards the obvious regression, not
 adversarial renaming.
 """
 
@@ -14,25 +18,36 @@ import ast
 from tools.mocolint.astutil import call_name
 from tools.mocolint.registry import Rule, register
 
+# collective spellings × the operand-name fragments that bind them to the
+# gradsync/fsdp contract
+_GRAD_COLLECTIVES = ("pmean", "psum", "psum_scatter", "reduce_scatter",
+                     "all_gather")
+_PARAM_COLLECTIVES = ("all_gather", "psum_scatter", "reduce_scatter")
+
 
 @register
 class GradCollective(Rule):
     id = "R7"
-    title = "gradient pmean/psum only under moco_tpu/parallel/"
-    rationale = ("grads must route through the gradsync API so the "
-                 "configured sync mode and its telemetry stay in effect")
+    title = "gradient/param collectives only under moco_tpu/parallel/"
+    rationale = ("grads must route through the gradsync API and param "
+                 "gathers/scatters through the fsdp ShardingPlan, so the "
+                 "configured sync/sharding mode and its telemetry stay "
+                 "in effect")
     node_types = (ast.Call,)
 
     def visit(self, node, ctx):
-        if call_name(node.func) not in ("pmean", "psum") or not node.args:
+        fn = call_name(node.func)
+        if fn not in _GRAD_COLLECTIVES or not node.args:
             return
         first = node.args[0]
         if isinstance(first, ast.Name):
-            graddy = "grad" in first.id.lower()
+            opname = first.id.lower()
         elif isinstance(first, ast.Attribute):
-            graddy = "grad" in first.attr.lower()
+            opname = first.attr.lower()
         else:
-            graddy = False
+            opname = ""
+        graddy = "grad" in opname
+        paramy = fn in _PARAM_COLLECTIVES and "param" in opname
         if graddy:
             yield self.finding(
                 ctx, node.lineno,
@@ -40,4 +55,13 @@ class GradCollective(Rule):
                 "grads through the gradsync API (parallel/gradsync.GradSync)"
                 "; an inline pmean/psum on grads bypasses the configured "
                 "sync mode and its telemetry",
+            )
+        elif paramy:
+            yield self.finding(
+                ctx, node.lineno,
+                "parameter gather/scatter outside moco_tpu/parallel/ — "
+                "route param sharding through the fsdp ShardingPlan "
+                "(parallel/fsdp.py); an inline all_gather/psum_scatter on "
+                "params forks the per-leaf shard-axis bookkeeping the "
+                "plan's gather and scatter share",
             )

@@ -140,20 +140,36 @@ def _step_records(mesh, with_cost, family):
              ("demo", {}),
              ("fused+health", {"grad_sync": "fused",
                                "health_stride": HEALTH_STRIDE})]
+    if variant == "v3":
+        # the FSDP-sharded programs (ISSUE 15): params sharded over the
+        # 2-D mesh's fsdp axis, gathered on use, grads reduce-scattered.
+        # fused pins the exact-DP collective topology, quantized the
+        # compressed one (and, on a data>1 mesh, the multi-hop reduce).
+        # P2 verifies every collective axis is bound by the 2-D mesh.
+        modes += [("fsdp+fused", {"grad_sync": "fused",
+                                  "sharding": "fsdp"}),
+                  ("fsdp+quantized", {"grad_sync": "quantized",
+                                      "sharding": "fsdp"})]
     for mode, extra in modes:
         config = _proxy_config(variant=variant,
                                grad_sync=extra.get("grad_sync", mode),
                                **GRAD_SYNC_KNOBS, **{
                                    k: v for k, v in extra.items()
                                    if k != "grad_sync"})
-        state, model, tx, sched = _state_shapes(config, mesh)
-        step = build_train_step(config, model, tx, mesh, 8, sched)
+        step_mesh = mesh
+        if extra.get("sharding", "dp") != "dp":
+            from moco_tpu.parallel.mesh import mesh_for_config
+
+            step_mesh = mesh_for_config(config, mesh)
+        state, model, tx, sched = _state_shapes(config, step_mesh)
+        step = build_train_step(config, model, tx, step_mesh, 8, sched,
+                                state=state)
         closed = jax.make_jaxpr(step)(state, im, im)
         flops, nbytes = _cost(step, (state, im, im), with_cost)
         rec = make_record(
             f"{family}/{mode}", family, mode, closed,
             donated=_donated(closed),
-            meta={"mesh_axes": tuple(str(a) for a in mesh.axis_names)},
+            meta={"mesh_axes": tuple(str(a) for a in step_mesh.axis_names)},
         )
         # cost_analysis sees the PER-PARTITION program of an SPMD step;
         # scale to the whole global batch so the number is comparable to
@@ -257,6 +273,32 @@ def _gradsync_records(mesh):
                 "gradsync": gs,
                 "payload_shape": payload_shape,
                 "mesh_size": mesh.size,
+                "sync_bytes_per_step": gs.sync_bytes_per_step(),
+            },
+        ))
+    # the topology-aware multi-hop reduce (ISSUE 15): quantized over a
+    # 2-D mesh with BOTH axes > 1 — exact intra-hop psum + compressed
+    # inter-hop. P8 verifies the per-hop wire bytes (intra f32 + inter
+    # int8 payload + scales) sum to sync_bytes_per_step's claim.
+    if mesh.size >= 4:
+        from moco_tpu.parallel.mesh import create_mesh_2d
+
+        mesh2d = create_mesh_2d(mesh.size // 2, devices=list(mesh.devices.flat))
+        config = _proxy_config(grad_sync="quantized", **GRAD_SYNC_KNOBS)
+        gs = GradSync(
+            config, mesh2d.size,
+            axes=tuple(str(a) for a in mesh2d.axis_names),
+            axis_sizes=tuple(int(s) for s in mesh2d.devices.shape),
+        )
+        fn, args, payload_shape = gs.audit_region_program(params, mesh2d)
+        closed = jax.make_jaxpr(fn)(*args)
+        records.append(make_record(
+            "gradsync/quantized@2d", "gradsync", "quantized@2d", closed,
+            meta={
+                "mesh_axes": tuple(str(a) for a in mesh2d.axis_names),
+                "gradsync": gs,
+                "payload_shape": payload_shape,
+                "mesh_size": mesh2d.size,
                 "sync_bytes_per_step": gs.sync_bytes_per_step(),
             },
         ))

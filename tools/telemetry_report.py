@@ -173,7 +173,7 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
     # incidents = events that signal trouble; routine markers the driver
     # emits on purpose (epoch/eval bookkeeping) are reported separately,
     # matching the driver's own `incidents` counter (log_event-routed only)
-    routine = {"epoch_summary", "knn_eval", "grad_sync"}
+    routine = {"epoch_summary", "knn_eval", "grad_sync", "sharding"}
     incidents = {k: v for k, v in events_by_kind.items() if k not in routine}
 
     summary: dict = {
@@ -234,6 +234,14 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
             if k not in ("kind", "event", "t", "schema")
         }
         summary["grad_sync"] = last
+    # sharding plan (ISSUE 15): mode + mesh shape + measured per-device
+    # param/opt bytes, from the one routine `sharding` event
+    sh_events = [e for e in events if e.get("event") == "sharding"]
+    if sh_events:
+        summary["sharding"] = {
+            k: v for k, v in sh_events[-1].items()
+            if k not in ("kind", "event", "t", "schema")
+        }
     if mfu:
         summary["mfu"] = {
             "mean": round(sum(mfu) / len(mfu), 5),
@@ -681,9 +689,27 @@ def render(summary: dict) -> str:
             f"p50 {comm['p50_ms']:.1f} ms · max {comm['max_ms']:.1f} ms · "
             f"share {100 * comm['share_mean']:.1f}%"
         )
+    sh = summary.get("sharding")
+    if sh:
+        mesh = sh.get("mesh_shape")
+        mesh_txt = ("×".join(f"{k}={v}" for k, v in mesh.items())
+                    if isinstance(mesh, dict) else "?")
+        lines.append(
+            f"sharding: {sh.get('mode', '?')} (mesh {mesh_txt}) · "
+            f"params {sh.get('param_bytes_per_device', 0) / 2**20:.2f} "
+            f"MiB/device · opt "
+            f"{sh.get('opt_bytes_per_device', 0) / 2**20:.2f} MiB/device"
+        )
     mfu = summary.get("mfu")
     if mfu:
-        lines.append(f"MFU: mean {100 * mfu['mean']:.2f}% · max {100 * mfu['max']:.2f}%")
+        label = ""
+        if sh and sh.get("mode") and sh.get("mode") != "dp":
+            # ISSUE 15 satellite: MFU is reported per sharding mode — the
+            # FLOPs basis is layout-invariant, the label says what layout
+            # achieved it
+            label = f" [{sh['mode']}]"
+        lines.append(f"MFU{label}: mean {100 * mfu['mean']:.2f}% · "
+                     f"max {100 * mfu['max']:.2f}%")
     elif summary["steps"]:
         # only a TRAINING stream can owe an MFU; a serve-only events file
         # (zero step records) has nothing to apologize for
