@@ -615,6 +615,11 @@ class ServeConfig:
                                       # the NEW engine's probe embeddings
                                       # falls below this (rank-one
                                       # collapse as seen from serving)
+    # dual swap (ISSUE 16): mean probe-row cosine between a paired
+    # bank's recorded probe features and the NEW engine's embedding of
+    # the same rows must clear this floor or the pair is refused
+    # (409 reload_bank_mismatch — the fleet quarantines the pair)
+    bank_agreement_min: float = 0.98
 
     def __post_init__(self):
         # the ONE bucket-ladder rule, shared with the runtime's own check
@@ -640,6 +645,11 @@ class ServeConfig:
                 "reload_probe and reload_min_spread must be >= 0 "
                 f"(0 disables the guard), got {self.reload_probe} / "
                 f"{self.reload_min_spread}"
+            )
+        if not -1.0 <= self.bank_agreement_min <= 1.0:
+            raise ValueError(
+                "bank_agreement_min is a cosine floor in [-1, 1], got "
+                f"{self.bank_agreement_min}"
             )
         if self.trace_mode not in ("off", "steps", "full"):
             raise ValueError(

@@ -46,6 +46,13 @@ def _digest(path: str) -> str:
     return h.hexdigest()
 
 
+def digest_file(path: str) -> str:
+    """Public chunked sha256 of one file — the same digest the manifests
+    record, exported so bank manifests (serve/bankbuild.py) can bind a
+    bank to its checkpoint with the identical hash scheme."""
+    return _digest(path)
+
+
 def manifest_path(ckpt_dir: str, step: int) -> str:
     return os.path.join(
         os.path.abspath(ckpt_dir), INTEGRITY_DIRNAME, f"{step}.json"

@@ -14,6 +14,9 @@ runtime over a pretraining checkpoint's momentum encoder. Layers:
                  engine (+ optional kNN classify), hot weight reload,
                  telemetry snapshots
     http.py      stdlib-HTTP front end (tools/serve.py mounts it)
+    bankbuild.py versioned kNN-bank builder (ISSUE 16): sharded,
+                 resumable corpus re-embed bound to its checkpoint by
+                 an integrity manifest — the dual swap's other half
     fleet.py     replicated-serving control plane (ISSUE 10): fleet
                  supervisor over N serve.py replicas, health-routed
                  front-end router, checkpoint watcher with integrity-
@@ -51,9 +54,14 @@ _EXPORTS = {
     "EmbeddingEngine": "engine",
     "ServeFrontend": "http",
     "decode_image": "http",
+    "BankMismatchError": "service",
     "CollapsedCheckpointError": "service",
     "EmbedService": "service",
     "ReloadRefusedError": "service",
+    "BankBuildError": "bankbuild",
+    "build_bank": "bankbuild",
+    "load_bank": "bankbuild",
+    "read_bank_meta": "bankbuild",
     "CheckpointWatcher": "fleet",
     "FleetPolicy": "fleet",
     "FleetRouter": "fleet",

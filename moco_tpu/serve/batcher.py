@@ -296,7 +296,10 @@ class MicroBatcher:
             try:
                 with self._tracer.span("engine", cat="serve", detail=True,
                                        bucket=bucket):
-                    out = np.asarray(self._run_batch(
+                    # asANYarray: the service tags rows with the engine
+                    # generation via an ndarray subclass (ISSUE 16 dual
+                    # swap); a plain asarray would strip the tag
+                    out = np.asanyarray(self._run_batch(
                         np.stack([p.payload for p in live])
                     ))
             except Exception as e:  # executor failure: every rider sees it
@@ -309,7 +312,7 @@ class MicroBatcher:
                 return
             done = time.monotonic()
             for p, row in zip(live, out):
-                p.resolve(result=np.asarray(row))
+                p.resolve(result=np.asanyarray(row))
                 self._request_span(p, done, "ok", seq)
         wait_s = now - live[0].enqueue_t
         with self._cond:

@@ -38,6 +38,16 @@ serve stack — into a production-shaped fleet:
     pretrain run continuously deploys with zero dropped requests.
     Replicas that were down during a roll converge on relaunch (the new
     checkpoint is pinned into their argv) or on the next watcher pass.
+  - versioned-bank lifecycle (ISSUE 16): with `bank_dir` set, a step
+    deploys ONLY with a verifying paired bank built by
+    tools/bank_build.py (`<bank_dir>/<step>/bank.npz` + an integrity
+    manifest binding it to the checkpoint's content hash). The roll
+    POSTs the pair and each replica dual-swaps (engine, bank) under one
+    generation bump; a `reload_bank_mismatch` verdict (the replica's
+    space-agreement probe) quarantines the PAIR as a unit, restores the
+    pre-roll last-known-good pair, and rolls back half-swapped
+    replicas. A manifest-less bank just WAITS (`bank_waiting` event) —
+    a bank-free fleet (empty bank_dir) is byte-for-byte unaffected.
 
 Every lifecycle transition lands as a `kind: "fleet"` record in the
 fleet's events.jsonl, stamped with the PR 8 run/trace ids the replicas
@@ -434,13 +444,15 @@ class CheckpointWatcher:
 class FleetSupervisor:
     """Supervise N serve replicas behind one router.
 
-    `child_argv(index, port, telemetry_dir, pretrained)` builds one
-    replica's command (tools/serve_fleet.py appends `--port`/
-    `--telemetry-dir` — and, after a hot reload, `--pretrained` — to the
-    operator's base command; tests point it at stub scripts).
-    `pretrained` is None until a watcher deployment happens, then the
-    deployed payload path — a replica relaunched after a reload roll
-    must come back with the NEW weights, not the boot-time ones."""
+    `child_argv(index, port, telemetry_dir, pretrained[, bank])` builds
+    one replica's command (tools/serve_fleet.py appends `--port`/
+    `--telemetry-dir` — and, after a hot reload, `--pretrained` and,
+    for dual-swap fleets, `--knn-bank` — to the operator's base
+    command; tests point it at stub scripts; a 4-arg callable still
+    works for bank-free fleets). `pretrained` is None until a watcher
+    deployment happens, then the deployed payload path — a replica
+    relaunched after a reload roll must come back with the NEW weights
+    (and bank), not the boot-time ones."""
 
     def __init__(
         self,
@@ -453,6 +465,7 @@ class FleetSupervisor:
         base_port: int = 0,
         policy: FleetPolicy | None = None,
         watch_dir: str = "",
+        bank_dir: str = "",
         env: dict | None = None,
         replica_env: dict | None = None,
         seed: int | None = None,
@@ -495,6 +508,24 @@ class FleetSupervisor:
         self._good_pretrained: str | None = None  # last payload every
                                        # replica deployed (quarantine
                                        # rollback target, ISSUE 13)
+        # versioned-bank lifecycle (ISSUE 16): when bank_dir is set, a
+        # checkpoint step deploys ONLY with a verifying paired bank
+        # (`<bank_dir>/<step>/bank.npz` + `.integrity/<step>.json`) —
+        # the dual swap rolls (engine, bank) together; a mismatched
+        # pair is quarantined as a UNIT and half-swapped replicas roll
+        # back to the last-known-good pair below. Empty bank_dir =
+        # bank-free fleet: zero behavior change.
+        self.bank_dir = bank_dir
+        self._good_bank: str | None = None
+        self._good_step = -1
+        self._prev_good: tuple | None = None  # (pretrained, bank, step)
+                                       # BEFORE the in-flight roll: a
+                                       # mismatch caught on a LATER
+                                       # replica must not call the bad
+                                       # pair "last known good"
+        self._bank_verified: set[int] = set()
+        self._bad_banks: set[int] = set()
+        self._bank_waiting_step = -1   # dedupe for bank_waiting emits
         # the roll runs from the watcher thread (new step) AND the
         # monitor thread (a recovered replica converging): serialize so
         # one replica never sees two concurrent /admin/reload POSTs
@@ -521,7 +552,15 @@ class FleetSupervisor:
 
     # -- structured events ---------------------------------------------------
     def _emit(self, event: str, **fields) -> None:
-        record = {"v": 1, "t": round(time.time(), 3), "kind": "fleet",
+        self._emit_record("fleet", event, **fields)
+
+    def _emit_record(self, kind: str, event: str, **fields) -> None:
+        """One structured record into events.jsonl + incidents. Fleet
+        lifecycle stays `kind:"fleet"`; the bank lifecycle (ISSUE 16)
+        emits `kind:"bank"` under the SAME run_id so a promotion's
+        build/swap/quarantine/rollback and the fleet's reload roll are
+        one timeline for obsd and telemetry_report."""
+        record = {"v": 1, "t": round(time.time(), 3), "kind": kind,
                   "event": event, "run_id": self.run_id,
                   "trace_id": self.tracer.trace_id}
         record.update(fields)
@@ -533,7 +572,7 @@ class FleetSupervisor:
                 f.flush()
                 os.fsync(f.fileno())
         detail = " ".join(f"{k}={v}" for k, v in fields.items())
-        log_event("fleet", f"{event} {detail}".strip())
+        log_event(kind, f"{event} {detail}".strip())
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
@@ -637,13 +676,21 @@ class FleetSupervisor:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "run_id": self.run_id,
                 "router": self._router_counters(),
                 "replicas": [r.snapshot() for r in self.replicas],
                 "target_step": self._target_step,
                 "rolling_restart": self._roll is not None,
             }
+            if self.bank_dir:
+                out["bank"] = {
+                    "dir": self.bank_dir,
+                    "good_step": self._good_step,
+                    "good_bank": self._good_bank,
+                    "quarantined": sorted(self._bad_banks),
+                }
+            return out
 
     def _router_counters(self) -> dict:
         # caller holds the lock
@@ -826,8 +873,18 @@ class FleetSupervisor:
         with self._lock:
             pretrained = self._current_pretrained
             target = self._target_step
-        argv = self._child_argv(r.index, r.port, r.telemetry_dir,
-                                pretrained)
+            bank = self._good_bank
+        try:
+            # dual-swap fleets (ISSUE 16) pin the deployed BANK into the
+            # relaunch argv alongside the weights: a replica dying after
+            # a dual swap must boot on the (weights, bank) pair, never
+            # new weights over its boot-time bank (cross-space answers)
+            argv = self._child_argv(r.index, r.port, r.telemetry_dir,
+                                    pretrained, bank)
+        except TypeError:
+            # 4-arg child_argv (bank-free fleets, older test stubs)
+            argv = self._child_argv(r.index, r.port, r.telemetry_dir,
+                                    pretrained)
         env = dict(os.environ if self._env is None else self._env)
         env.update(self.tracer.child_env())
         env.update(self._replica_env.get(r.index, {}))
@@ -1166,6 +1223,11 @@ class FleetSupervisor:
             step, path = self._target_step, self._target_path
         if path is None:
             return
+        bank = self._paired_bank(step)
+        if self.bank_dir and bank is None:
+            return  # pair incomplete (bank still building / corrupt):
+            # the step WAITS — encoder-only deployments on bank-free
+            # fleets are untouched (bank_dir empty never gets here)
         for r in list(self.replicas):
             if self._stop.is_set():
                 return
@@ -1175,7 +1237,7 @@ class FleetSupervisor:
                         or r.reload_refused_step >= step)
             if skip:
                 continue
-            ok, detail = self._post_reload(r, step, path)
+            ok, detail = self._post_reload(r, step, path, bank)
             if ok:
                 with self._lock:
                     r.deployed_step = step
@@ -1184,8 +1246,17 @@ class FleetSupervisor:
                     # completed roll: with one replica down, a later
                     # quarantine must still roll the relaunch argv back
                     # to this payload, never past it to the boot weights
-                    # — and only NOW may the relaunch argv pin it
+                    # — and only NOW may the relaunch argv pin it.
+                    # The PREVIOUS pair is retained first: a bank
+                    # mismatch surfacing on a LATER replica of this same
+                    # roll rolls back to it, never to the bad pair.
+                    if self._good_step != step:
+                        self._prev_good = (self._good_pretrained,
+                                           self._good_bank,
+                                           self._good_step)
                     self._good_pretrained = path
+                    self._good_bank = bank
+                    self._good_step = step
                     self._current_pretrained = path
                 self._emit("reload_replica", replica=r.index, step=step,
                            status="ok", detail=detail)
@@ -1194,15 +1265,23 @@ class FleetSupervisor:
                     announce = r.reload_announced != step
                     r.reload_announced = step
                     if detail.startswith("status 409"):
-                        # 409 is reload_refused ONLY (kNN bank, ladder
-                        # change — http.py maps transient load failures
-                        # to 503): terminal for this step, stop
-                        # re-attempting; transient failures retry on the
-                        # next pass
+                        # 409 is reload_refused ONLY (bank without a
+                        # pair, ladder change — http.py maps transient
+                        # load failures to 503): terminal for this step,
+                        # stop re-attempting; transient failures retry
+                        # on the next pass
                         r.reload_refused_step = step
                 if announce:
                     self._emit("reload_failed", replica=r.index,
                                step=step, detail=detail)
+                if "reload_bank_mismatch" in detail:
+                    # dual swap (ISSUE 16): the replica judged the
+                    # (checkpoint, bank) PAIR inconsistent (hash binding
+                    # or the space-agreement probe). The verdict is
+                    # deterministic — quarantine the pair as a unit, pin
+                    # last-known-good, roll back half-swapped replicas
+                    self._quarantine_pair(step, detail)
+                    return
                 if "reload_collapsed" in detail:
                     # drift guard (ISSUE 13): the replica judged the
                     # CHECKPOINT collapsed (degenerate probe embeddings),
@@ -1239,6 +1318,10 @@ class FleetSupervisor:
             self._watcher.quarantine(
                 step, f"reload drift guard: {detail[:160]}"
             )
+        if self.bank_dir:
+            # the pair dies as a unit: a bank built by a collapsed
+            # checkpoint's encoder is as unusable as the weights
+            self._quarantine_bank(step, "paired checkpoint collapsed")
         log_event(
             "fleet",
             f"checkpoint step {step} refused by the reload drift guard "
@@ -1246,9 +1329,162 @@ class FleetSupervisor:
             f"keeps serving the previous weights",
         )
 
-    def _post_reload(self, r: ReplicaState, step: int,
-                     path: str) -> tuple[bool, str]:
-        body = json.dumps({"pretrained": path, "step": step}).encode()
+    # -- versioned-bank lifecycle (ISSUE 16) ---------------------------------
+    def _paired_bank(self, step: int) -> str | None:
+        """The verified bank payload paired with checkpoint `step`, or
+        None when the pair is incomplete. stdlib-only (mocolint R11):
+        the integrity hash check, not numpy, decides eligibility here —
+        the replica's space-agreement probe is the deep check.
+
+        A MISSING manifest means the build is still in flight (the
+        builder writes it last): the step waits and a deduped
+        `bank_waiting` event carries how far serving lags. A manifest
+        that fails verification quarantines the bank immediately."""
+        if not self.bank_dir:
+            return None
+        if step in self._bad_banks:
+            return None
+        if not os.path.exists(manifest_path(self.bank_dir, step)):
+            with self._lock:
+                announce = self._bank_waiting_step != step
+                self._bank_waiting_step = step
+                good_step = self._good_step
+            if announce:
+                self._emit_record(
+                    "bank", "bank_waiting", step=step,
+                    age_steps=(step - good_step if good_step >= 0
+                               else None),
+                    detail="no bank manifest yet — build in flight?",
+                )
+            return None
+        if step not in self._bank_verified:
+            reason = verify_step(self.bank_dir, step)
+            if reason is not None:
+                self._bad_banks.add(step)
+                self._quarantine_bank(
+                    step, f"bank manifest verification failed: {reason}"
+                )
+                return None
+            self._bank_verified.add(step)
+        step_dir = os.path.join(self.bank_dir, str(step))
+        try:
+            names = sorted(
+                f for f in os.listdir(step_dir) if f.endswith(".npz")
+            )
+        except OSError:
+            return None
+        return os.path.join(step_dir, names[0]) if names else None
+
+    def _quarantine_pair(self, step: int, detail: str) -> None:
+        """A replica's space-agreement check judged the (checkpoint,
+        bank) pair INCONSISTENT. The verdict is deterministic (seeded
+        probe rows, content-hashed artifacts), so one replica's verdict
+        stands for the fleet: quarantine BOTH halves as a unit, restore
+        the pre-roll last-known-good pair, and roll back any replica
+        that already swapped onto the bad pair."""
+        with self._lock:
+            if self._good_step == step and self._prev_good is not None:
+                # a half-swapped roll advanced known-good onto the bad
+                # pair before the mismatch surfaced: un-advance it
+                (self._good_pretrained, self._good_bank,
+                 self._good_step) = self._prev_good
+            if self._target_step == step:
+                self._target_path = None
+                self._target_step = max(self._good_step, -1)
+            self._current_pretrained = self._good_pretrained
+        self._emit_record("bank", "quarantine", step=step,
+                          detail=detail[:200])
+        if self._watcher is not None:
+            self._watcher.quarantine(
+                step, f"bank/encoder space mismatch: {detail[:160]}"
+            )
+        self._quarantine_bank(step, "pair failed the space-agreement "
+                                    "check")
+        self._rollback_half_swapped(step)
+        log_event(
+            "fleet",
+            f"(checkpoint, bank) pair for step {step} failed the "
+            f"space-agreement check; quarantined as a unit — the fleet "
+            f"keeps serving the last-known-good pair",
+        )
+
+    def _quarantine_bank(self, step: int, reason: str) -> None:
+        """Move `<bank_dir>/<step>` to `.quarantine/` and drop its
+        manifest — the PR 4 preflight pattern the checkpoint watcher
+        uses, applied to the bank half of a condemned pair. Best-effort:
+        filesystem errors are emitted, never raised into the roll."""
+        if not self.bank_dir:
+            return
+        self._bad_banks.add(step)
+        self._bank_verified.discard(step)
+        src = os.path.join(self.bank_dir, str(step))
+        if not os.path.exists(src):
+            return
+        try:
+            qdir = os.path.join(self.bank_dir, QUARANTINE_DIRNAME)
+            os.makedirs(qdir, exist_ok=True)
+            target = os.path.join(qdir, str(step))
+            if os.path.exists(target):
+                target = f"{target}.{int(time.time())}"
+            os.rename(src, target)
+            try:
+                os.remove(manifest_path(self.bank_dir, step))
+            except OSError:
+                pass
+            self._emit_record("bank", "bank_quarantine", step=step,
+                              reason=reason, moved_to=target)
+        except OSError as e:
+            self._emit_record("bank", "bank_quarantine_error", step=step,
+                              detail=f"{type(e).__name__}: {e}")
+
+    def _rollback_half_swapped(self, step: int) -> None:
+        """Return every replica already swapped onto the condemned pair
+        to the last-known-good one. With a good pair on record the
+        rollback is itself a dual swap (reload POST — zero downtime); a
+        fleet condemned on its FIRST roll has no reloadable good pair,
+        so the replica restarts onto its boot-time (weights, bank) argv
+        — capacity dips to N−1 briefly, correctness never."""
+        with self._lock:
+            good = (self._good_pretrained, self._good_bank,
+                    self._good_step)
+            victims = [r for r in self.replicas
+                       if not r.abandoned and r.deployed_step >= step]
+        for r in victims:
+            if good[0] is not None and good[2] >= 0:
+                ok, detail = self._post_reload(r, good[2], good[0],
+                                               good[1])
+                if ok:
+                    with self._lock:
+                        r.deployed_step = good[2]
+                    self._emit_record("bank", "rollback", replica=r.index,
+                                      from_step=step, to_step=good[2],
+                                      mode="reload")
+                    continue
+                self._emit("reload_failed", replica=r.index,
+                           step=good[2],
+                           detail=f"rollback failed: {detail}")
+            # no reloadable good pair (or the rollback POST failed):
+            # restart the replica onto its boot argv — the launch path
+            # pins _current_pretrained, already reset to known-good
+            with self._lock:
+                r.deployed_step = -1
+                alive = r.alive()
+            if alive:
+                r.proc.terminate()
+            self._emit_record("bank", "rollback", replica=r.index,
+                              from_step=step, to_step=good[2],
+                              mode="restart")
+
+    def _post_reload(self, r: ReplicaState, step: int, path: str,
+                     bank: str | None = None) -> tuple[bool, str]:
+        req = {"pretrained": path, "step": step}
+        if bank is not None:
+            # the dual swap: the replica verifies the pair (manifest,
+            # checkpoint-hash binding, space-agreement probe) and rolls
+            # engine + bank under one generation bump
+            req["bank"] = bank
+            req["bank_step"] = step
+        body = json.dumps(req).encode()
         conn = http.client.HTTPConnection(
             r.host, r.port, timeout=self.policy.reload_timeout_s
         )

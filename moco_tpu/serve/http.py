@@ -11,19 +11,29 @@ the protocol is four routes of JSON.
                  →   200 {"embedding": [...], "cached": bool}
     POST /v1/knn     same body → 200 {"class": int, "cached": bool}
                      (+"embedding" when "return_embedding" is true)
-    POST /admin/reload  {"pretrained": <path>, "step": <int>?} → hot
-                     weight reload (ISSUE 10): build + warm a new engine
+    POST /admin/reload  {"pretrained": <path>, "step": <int>?,
+                     "bank": <path>?, "bank_step": <int>?} → hot weight
+                     reload (ISSUE 10): build + warm a new engine
                      off-path, atomically swap between micro-batches.
+                     With "bank", the dual swap (ISSUE 16): engine +
+                     kNN bank roll together under one generation bump.
                      200 on swap; 409 {"error": "reload_refused"} when
-                     this process's config can never accept it (kNN
-                     bank, image_size/ladder change — terminal, the
-                     fleet stops retrying); 503 {"error":
-                     "reload_failed"} when the checkpoint couldn't be
-                     loaded/warmed (possibly transient — retried). Old
-                     weights keep serving on every failure.
-                     OPERATOR-ONLY: the fleet router never
+                     this process's config can never accept it (bank
+                     configured but no pair offered — body carries
+                     "bank_step", the serving bank's recorded step —
+                     image_size/ladder change; terminal, the fleet
+                     stops retrying); 409 {"error":
+                     "reload_bank_mismatch"} when the offered
+                     (checkpoint, bank) pair fails verification — the
+                     fleet quarantines the pair and rolls back; 503
+                     {"error": "reload_failed"} when the checkpoint
+                     couldn't be loaded/warmed (possibly transient —
+                     retried). Old weights keep serving on every
+                     failure. OPERATOR-ONLY: the fleet router never
                      proxies /admin/* — only the fleet supervisor (or an
                      operator on the replica's own port) reaches it.
+    GET  /admin/bank 200 <service.bank_info()> — which embedding space
+                     this replica answers from (ISSUE 16)
     GET  /healthz    200 {"status": "ok"} | 503 {"status": "draining"}
     GET  /stats      200 <service.stats()>
 
@@ -45,6 +55,7 @@ import numpy as np
 
 from moco_tpu.serve.batcher import RejectionError
 from moco_tpu.serve.service import (
+    BankMismatchError,
     CollapsedCheckpointError,
     ReloadRefusedError,
 )
@@ -123,6 +134,8 @@ def _make_handler(service):
                 self._send(503 if draining else 200, body)
             elif self.path == "/stats":
                 self._send(200, service.stats())
+            elif self.path == "/admin/bank":
+                self._send(200, service.bank_info())
             else:
                 self._send(404, {"error": "not_found", "path": self.path})
 
@@ -182,6 +195,10 @@ def _make_handler(service):
                     raise ValueError('body needs {"pretrained": <path>}')
                 step = req.get("step")
                 step = int(step) if step is not None else None
+                bank = req.get("bank")
+                bank = str(bank) if bank else None
+                bank_step = req.get("bank_step")
+                bank_step = int(bank_step) if bank_step is not None else None
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 # a malformed REQUEST (non-integer step included) is the
                 # client's bug, not a checkpoint failure: 400, not 409
@@ -191,8 +208,16 @@ def _make_handler(service):
                 self._send(503, {"error": "draining"})
                 return
             try:
-                entry = service.reload(str(req["pretrained"]), step)
+                entry = service.reload(str(req["pretrained"]), step,
+                                       bank=bank, bank_step=bank_step)
                 self._send(200, {"status": "reloaded", **entry})
+            except BankMismatchError as e:
+                # dual swap (ISSUE 16): the offered (checkpoint, bank)
+                # PAIR is bad — its own code so the fleet quarantines
+                # the pair as a unit and rolls back half-swapped
+                # replicas (checked before ReloadRefusedError: it IS one)
+                self._send(409, {"error": "reload_bank_mismatch",
+                                 "detail": str(e)})
             except CollapsedCheckpointError as e:
                 # drift guard (ISSUE 13): the CHECKPOINT is bad, not this
                 # process's config — its own error code so the fleet
@@ -200,10 +225,15 @@ def _make_handler(service):
                 self._send(409, {"error": "reload_collapsed",
                                  "detail": str(e)})
             except ReloadRefusedError as e:
-                # TERMINAL for this process config (kNN bank, image_size,
-                # ladder): 409 — the fleet stops retrying this step here
-                self._send(409, {"error": "reload_refused",
-                                 "detail": str(e)})
+                # TERMINAL for this process config (bank without a pair,
+                # image_size, ladder): 409 — the fleet stops retrying
+                # this step here. Under a configured versioned bank the
+                # body names the bank's recorded checkpoint step so the
+                # operator sees WHICH pair is missing its other half.
+                body = {"error": "reload_refused", "detail": str(e)}
+                if getattr(e, "bank_step", None) is not None:
+                    body["bank_step"] = e.bank_step
+                self._send(409, body)
             except ValueError as e:
                 # load/warmup failure: possibly transient (NFS blip, a
                 # momentary OOM) — 503 so the fleet's converge loop

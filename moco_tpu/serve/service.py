@@ -19,14 +19,28 @@ renders the last snapshot as its `serve:` section.
 Hot weight reload (ISSUE 10): `reload(path)` builds a SECOND engine from
 a new checkpoint via the configured factory, warms its whole bucket
 ladder off-path (the live engine keeps serving throughout), then swaps
-`self.engine` in one reference assignment. The batcher calls the engine
-through `_run_batch`, which reads `self.engine` exactly once per
-coalesced batch — so every micro-batch executes entirely on one engine
-and the swap lands BETWEEN batches, never inside one. The content-hash
-embedding cache is cleared at swap (its rows are functions of the old
-weights); requests in flight during the swap simply ride whichever
-engine their batch drew — both answer correctly for their weights, and
-nothing is dropped.
+the serving state in one reference assignment. The batcher calls the
+engine through `_run_batch`, which reads the serving state exactly once
+per coalesced batch — so every micro-batch executes entirely on one
+engine and the swap lands BETWEEN batches, never inside one. The
+content-hash embedding cache is cleared at swap (its rows are functions
+of the old weights); requests in flight during the swap simply ride
+whichever engine their batch drew — both answer correctly for their
+weights, and nothing is dropped.
+
+Atomic dual swap (ISSUE 16): under a configured kNN bank, a reload must
+carry a VERIFIED paired bank (built by tools/bank_build.py against the
+same checkpoint) or it is refused — the old "never under a bank" guard
+generalized to "only without a verified pair". The pair is vetted
+before any engine is built (manifest integrity, checkpoint-hash
+binding) and after warmup by the space-agreement check (the new engine
+re-embeds the bank's recorded seeded probe rows; low cosine ⇒
+`BankMismatchError`, the fleet's quarantine signal). The swap itself
+publishes (engine, bank) under ONE generation bump: `_run_batch` tags
+every feature row with the generation it was embedded under, and
+`classify()` votes against the bank REGISTERED FOR THAT GENERATION — a
+request whose embed rode the old engine across the swap votes against
+the old bank, never across spaces.
 
 Shutdown: `drain()` (SIGTERM in tools/serve.py) stops admission, lets
 every accepted request finish, and flushes the final snapshot — reject
@@ -68,6 +82,41 @@ class CollapsedCheckpointError(ReloadRefusedError):
     quarantines the step dir so no replica (or later fleet) promotes it."""
 
 
+class BankMismatchError(ReloadRefusedError):
+    """The offered (checkpoint, bank) pair failed verification (ISSUE
+    16): manifest integrity, checkpoint-hash binding, feature-dim, or
+    the space-agreement probe check. Terminal like every refusal, and —
+    like a collapsed checkpoint — the ARTIFACTS are at fault, not this
+    process's config: the fleet quarantines the pair as a unit and rolls
+    back any half-swapped replica to the last-known-good pair."""
+
+
+class _TaggedRows(np.ndarray):
+    """Feature rows stamped with the engine generation that embedded
+    them. Slicing/viewing preserves the tag (`__array_finalize__`), so
+    the per-request row the batcher peels off a coalesced batch still
+    knows which generation produced it — classify() uses that to vote
+    against the SAME generation's bank across a dual swap."""
+
+    gen: int = -1
+
+    def __array_finalize__(self, obj):
+        if obj is not None:
+            self.gen = getattr(obj, "gen", -1)
+
+
+class _ServingState:
+    """The (engine, generation) pair `_run_batch` reads in ONE attribute
+    load — a dual swap replaces the whole object, so a micro-batch can
+    never see the new engine with the old generation or vice versa."""
+
+    __slots__ = ("engine", "gen")
+
+    def __init__(self, engine, gen: int):
+        self.engine = engine
+        self.gen = gen
+
+
 class EmbedService:
     def __init__(
         self,
@@ -88,6 +137,8 @@ class EmbedService:
         knn_temperature: float = 0.07,
         reload_probe: int = 8,
         reload_min_spread: float = 1e-4,
+        knn_bank_meta: dict | None = None,
+        bank_agreement_min: float = 0.98,
     ):
         self.engine = engine
         self.feat_dim = engine.warmup()  # every bucket compiled before traffic
@@ -141,18 +192,28 @@ class EmbedService:
             tracer=tracer,
             shed_spike_min=shed_spike_min,
         )
+        # dual swap (ISSUE 16): the (engine, generation) pair _run_batch
+        # reads atomically, the per-generation bank registry classify()
+        # resolves tagged rows against, and the versioned-bank metadata
+        # (None for a plain --knn-bank npz or a bank-free service)
+        self._serving = _ServingState(engine, 0)
+        self._knn_by_gen: dict = {}
+        self._bank_meta = knn_bank_meta
+        self.bank_agreement_min = float(bank_agreement_min)
+        self._bank_swaps = 0
+        # kNN vote parameters survive a bank swap (and let a bank-free
+        # service ADOPT a bank offered by a later dual-swap reload)
+        self._knn_defaults = {
+            "num_classes": int(num_classes),
+            "k": int(knn_k),
+            "temperature": float(knn_temperature),
+        }
         self._knn = None
         if knn_bank is not None:
             if knn_labels is None or len(knn_bank) != len(knn_labels):
                 raise ValueError("knn_bank needs matching knn_labels")
-            labels = np.asarray(knn_labels, np.int32)
-            self._knn = {
-                "bank": np.asarray(knn_bank, np.float32),
-                "labels": labels,
-                "num_classes": int(num_classes or labels.max() + 1),
-                "k": int(knn_k),
-                "temperature": float(knn_temperature),
-            }
+            self._knn = self._make_knn(knn_bank, knn_labels)
+            self._knn_by_gen[0] = self._knn
             # pre-compile the kNN program too: the first classify must not
             # pay a trace under live traffic (same rule as engine.warmup)
             self._knn_predict(np.ones((1, self.feat_dim), np.float32))
@@ -169,13 +230,30 @@ class EmbedService:
                 knn_bank_size=0 if self._knn is None else len(self._knn["bank"]),
             )
 
+    def _make_knn(self, bank, labels) -> dict:
+        labels = np.asarray(labels, np.int32)
+        d = self._knn_defaults
+        return {
+            "bank": np.asarray(bank, np.float32),
+            "labels": labels,
+            "num_classes": int(d["num_classes"] or labels.max() + 1),
+            "k": d["k"],
+            "temperature": d["temperature"],
+        }
+
     # -- the engine indirection (hot reload) ---------------------------------
     def _run_batch(self, images_u8: np.ndarray) -> np.ndarray:
-        """The batcher's executor. Reads `self.engine` EXACTLY once per
+        """The batcher's executor. Reads `self._serving` EXACTLY once per
         coalesced batch (one GIL-atomic attribute load), so a concurrent
         `reload()` swap can only land between micro-batches — every batch
-        runs whole on one engine, never half-and-half."""
-        return self.engine.embed(images_u8)
+        runs whole on one engine, never half-and-half. Rows come back
+        generation-tagged so classify() can vote against the SAME
+        generation's bank even when a dual swap landed mid-flight."""
+        serving = self._serving
+        rows = np.asarray(serving.engine.embed(images_u8))
+        tagged = rows.view(_TaggedRows)
+        tagged.gen = serving.gen
+        return tagged
 
     # -- request paths -------------------------------------------------------
     def embed(self, image: np.ndarray,
@@ -207,13 +285,16 @@ class EmbedService:
         )
         self._h_latency.observe(time.monotonic() - t0)
         if self.cache is not None:
+            row_gen = getattr(result, "gen", gen)  # the generation that
+            # actually embedded this row (tagged in _run_batch); falls
+            # back to the admission-time gen for untagged stub engines
             with self._gen_lock:
                 # a reload swapped engines while this request was in
                 # flight: its row came from the OLD weights and must not
                 # repopulate the just-cleared cache as a forever-stale
                 # hit. Under the lock the check and the put are one unit
                 # against reload's increment-then-clear.
-                if gen == self._engine_gen:
+                if row_gen == self._engine_gen:
                     self.cache.put(key, result)
         with self._lock:
             self.served += 1
@@ -228,13 +309,24 @@ class EmbedService:
                 "no kNN feature bank configured (serve with --knn-bank)"
             )
         embedding, cached = self.embed(image, deadline_s)
-        pred = self._knn_predict(embedding[None, :])
+        # generation-consistent vote (ISSUE 16): the row is tagged with
+        # the generation that embedded it; vote against THAT generation's
+        # bank. A cache hit is always current-generation (the cache is
+        # cleared inside the swap's gen bump), and a row whose generation
+        # left the registry (two swaps inside one request lifetime) falls
+        # back to the current bank — never a silent cross-space vote
+        # under a single swap.
+        row_gen = getattr(embedding, "gen", None)
+        knn = self._knn_by_gen.get(row_gen, self._knn) \
+            if row_gen is not None else self._knn
+        pred = self._knn_predict(embedding[None, :], knn=knn)
         return int(pred[0]), embedding, cached
 
-    def _knn_predict(self, features: np.ndarray) -> np.ndarray:
+    def _knn_predict(self, features: np.ndarray,
+                     knn: dict | None = None) -> np.ndarray:
         from moco_tpu.ops.knn import knn_predict
 
-        k = self._knn
+        k = self._knn if knn is None else knn
         return np.asarray(knn_predict(
             features, k["bank"], k["labels"], k["num_classes"],
             k=k["k"], temperature=k["temperature"],
@@ -270,11 +362,21 @@ class EmbedService:
         arch/buckets config; tests wire in-process builders."""
         self._engine_factory = factory
 
-    def reload(self, pretrained: str, step: int | None = None) -> dict:
+    def reload(self, pretrained: str, step: int | None = None,
+               bank: str | None = None,
+               bank_step: int | None = None) -> dict:
         """Build + warm a new engine from `pretrained` OFF the request
         path, then atomically swap it in (see `_run_batch`). Raises
         ValueError on any failure — the old engine keeps serving, nothing
-        is dropped. Serialized: concurrent reloads queue on the lock."""
+        is dropped. Serialized: concurrent reloads queue on the lock.
+
+        Dual swap (ISSUE 16): pass `bank` (a versioned bank npz built by
+        tools/bank_build.py against the SAME checkpoint) to roll engine
+        and kNN bank together under one generation bump. The pair is
+        verified before the swap — manifest integrity, checkpoint-hash
+        binding, feature-dim, and the post-warmup space-agreement probe —
+        and any failure raises `BankMismatchError` with the old pair
+        untouched. Under a configured bank, a bank-LESS reload refuses."""
         if self._engine_factory is None:
             raise ReloadRefusedError(
                 "hot reload is not configured (no engine factory; serve "
@@ -285,18 +387,31 @@ class EmbedService:
             # un-warmed) engine runs before the minutes-scale ladder
             # warmup, so a refused reload — which a fleet's converge loop
             # may re-attempt — never burns a checkpoint load + compile
-            if self._knn is not None:
+            if self._knn is not None and bank is None:
                 # the feature bank was computed by the OLD encoder; new
                 # embeddings live in a different space, so /v1/knn would
-                # silently classify across spaces — refuse, like the
-                # image_size case: regenerate the bank and restart
-                raise ReloadRefusedError(
-                    "hot reload is refused under a configured kNN bank: "
-                    "the bank's features were computed by the old "
-                    "encoder and would silently mismatch the new "
-                    "embedding space — rebuild the bank for the new "
-                    "checkpoint and restart instead"
+                # silently classify across spaces — refuse UNLESS the
+                # reload carries a verified paired bank (the dual swap)
+                e = ReloadRefusedError(
+                    "hot reload is refused under a configured kNN bank "
+                    "without a verified paired bank: the bank's features "
+                    "were computed by the old encoder and would silently "
+                    "mismatch the new embedding space — build a paired "
+                    "bank with tools/bank_build.py against the new "
+                    "checkpoint and reload the (pretrained, bank) pair "
+                    "together"
                 )
+                e.bank_step = None if self._bank_meta is None \
+                    else self._bank_meta.get("step")
+                raise e
+            new_knn = new_meta = None
+            if bank is not None:
+                # the whole pair is vetted BEFORE the factory runs: a
+                # doctored or torn bank must cost hashing, not a
+                # checkpoint load + ladder compile
+                bank_feats, bank_labels, new_meta = \
+                    self._verify_bank_pair(bank, pretrained, bank_step)
+                new_knn = self._make_knn(bank_feats, bank_labels)
             t0 = time.monotonic()
             try:
                 new_engine = self._engine_factory(pretrained)
@@ -338,19 +453,55 @@ class EmbedService:
                     f"{probe['probe_drift']:.4f}) — the checkpoint looks "
                     "collapsed; keeping the previous weights"
                 )
+            agreement = None
+            if new_knn is not None:
+                # space-agreement check (ISSUE 16, generalizing the PR 13
+                # probe guard): the NEW engine re-embeds the bank's
+                # recorded seeded probe rows; a bank whose manifest lies
+                # about its checkpoint scores near chance and the pair is
+                # refused as a unit — never half-swapped
+                agreement = self._bank_agreement(new_engine, new_meta,
+                                                 feat_dim, bank)
             warm_s = time.monotonic() - t0
-            # THE swap: one reference assignment; the next micro-batch the
-            # flusher executes reads the new engine
-            self.engine = new_engine
-            self.feat_dim = feat_dim
+            if new_knn is not None:
+                # pre-compile the new kNN program off-path (same rule as
+                # engine.warmup: the first classify after the swap must
+                # not pay a trace under live traffic)
+                self._knn_predict(np.ones((1, feat_dim), np.float32),
+                                  knn=new_knn)
+            # THE swap, one generation bump for BOTH halves: register the
+            # new generation's bank, publish the new serving state (what
+            # _run_batch reads), then bump the gen + clear the cache
+            # under the gen lock. Rows embedded by the old engine stay
+            # tagged with the old generation and keep voting against the
+            # old bank; the first batch on the new state gets the new
+            # pair — no interleaving yields a cross-space answer.
+            new_gen = self._engine_gen + 1
+            if new_knn is not None:
+                self._knn_by_gen[new_gen] = new_knn
+                for g in [g for g in self._knn_by_gen
+                          if g < new_gen - 1]:
+                    del self._knn_by_gen[g]  # keep current + previous
+            elif self._knn is not None:
+                # bank-less swap on a bank-free service never gets here
+                # (the refusal above); this re-registers the unchanged
+                # bank under the new generation
+                self._knn_by_gen[new_gen] = self._knn
+            self._serving = _ServingState(new_engine, new_gen)
             with self._gen_lock:
                 # cached rows are functions of the OLD weights; serving
                 # them after the swap would silently mix model versions.
                 # Increment + clear under the gen lock so no in-flight
                 # old-engine request can slip a row in after the clear.
-                self._engine_gen += 1
+                self._engine_gen = new_gen
                 if self.cache is not None:
                     self.cache.clear()
+            self.engine = new_engine
+            self.feat_dim = feat_dim
+            if new_knn is not None:
+                self._knn = new_knn
+                self._bank_meta = new_meta
+                self._bank_swaps += 1
             entry = {
                 "step": step,
                 "pretrained": pretrained,
@@ -359,6 +510,13 @@ class EmbedService:
             }
             if probe is not None:
                 entry.update(probe)
+            if new_knn is not None:
+                entry["bank"] = bank
+                entry["bank_step"] = new_meta.get("step") \
+                    if new_meta else bank_step
+                entry["bank_rows"] = len(new_knn["bank"])
+                if agreement is not None:
+                    entry["bank_agreement"] = round(agreement, 6)
             with self._lock:
                 self.reloads += 1
                 self._reload_history.append(entry)
@@ -366,11 +524,109 @@ class EmbedService:
             log_event(
                 "serve",
                 f"hot-reloaded weights from {pretrained} "
-                f"(step {step}, ladder warmed in {warm_s:.1f}s)",
+                f"(step {step}, ladder warmed in {warm_s:.1f}s"
+                + (f", bank step {entry['bank_step']}"
+                   if new_knn is not None else "") + ")",
             )
             if self.registry is not None:
                 self.registry.emit("event", event="serve_reload", **entry)
+                if new_knn is not None:
+                    self.registry.emit(
+                        "bank", event="swap", step=step,
+                        bank_step=entry["bank_step"],
+                        rows=entry["bank_rows"], generation=new_gen,
+                        agreement=entry.get("bank_agreement"),
+                    )
             return entry
+
+    def _verify_bank_pair(self, bank: str, pretrained: str,
+                          bank_step: int | None):
+        """Pre-factory vetting of an offered (checkpoint, bank) pair.
+        Returns (features, labels, meta). Raises `BankMismatchError`
+        (terminal — quarantine the pair) for integrity / binding
+        failures, plain ValueError (retryable 503) for a bank whose
+        manifest simply has not landed yet — the builder writes the
+        manifest LAST, so 'no manifest' means 'still building': wait."""
+        from moco_tpu.serve import bankbuild
+
+        try:
+            feats, labels, meta = bankbuild.load_bank(bank)
+        except (OSError, ValueError, KeyError) as e:
+            raise ValueError(f"cannot load bank {bank!r}: {e}") from e
+        if meta is None:
+            raise ValueError(
+                f"bank {bank!r} has no integrity manifest yet — a "
+                "versioned bank writes its manifest last, so this build "
+                "may still be in flight; retry once it lands"
+            )
+        bad = bankbuild.verify_bank(meta["bank_dir"], meta["step"])
+        if bad is not None:
+            raise BankMismatchError(
+                f"bank {bank!r} fails its integrity manifest: {bad}"
+            )
+        from moco_tpu.resilience.integrity import digest_file
+
+        ckpt_sha = digest_file(pretrained)
+        if meta.get("checkpoint_sha256") != ckpt_sha:
+            raise BankMismatchError(
+                f"bank {bank!r} (step {meta['step']}) was built against "
+                f"checkpoint sha256 {meta.get('checkpoint_sha256')!r}, "
+                f"but {pretrained!r} hashes to {ckpt_sha!r} — not a "
+                "pair; build a paired bank with tools/bank_build.py"
+            )
+        if bank_step is not None and int(bank_step) != meta["step"]:
+            raise BankMismatchError(
+                f"offered bank_step {bank_step} != bank's recorded step "
+                f"{meta['step']}"
+            )
+        if len(feats) != len(labels) or np.asarray(feats).ndim != 2:
+            raise BankMismatchError(
+                f"bank {bank!r} arrays are malformed: features "
+                f"{np.asarray(feats).shape} vs labels "
+                f"{np.asarray(labels).shape}"
+            )
+        return feats, labels, meta
+
+    def _bank_agreement(self, new_engine, meta, feat_dim: int,
+                        bank: str) -> float:
+        """The space-agreement check: mean row-wise cosine between the
+        bank's recorded probe features and the NEW engine's embedding of
+        the same seeded probe rows. Raises `BankMismatchError` below the
+        configured floor (or when the comparison is impossible)."""
+        from moco_tpu.serve import bankbuild
+
+        if meta is None or not (meta.get("probe") or {}).get("features"):
+            raise BankMismatchError(
+                f"bank {bank!r} records no probe rows — cannot verify "
+                "space agreement; rebuild it with tools/bank_build.py"
+            )
+        if meta.get("feat_dim") not in (None, feat_dim):
+            raise BankMismatchError(
+                f"bank {bank!r} feat_dim {meta['feat_dim']} != new "
+                f"engine feat_dim {feat_dim}"
+            )
+        cap = new_engine.buckets[-1]  # probe rows are a deterministic
+        # prefix of one rng stream, so a ladder smaller than the
+        # recorded row count compares a prefix — still sound
+
+        def embed_prefix(batch):
+            return new_engine.embed(batch[: min(len(batch), cap)])
+
+        try:
+            agreement = bankbuild.probe_agreement(embed_prefix, meta)
+        except (ValueError, KeyError) as e:
+            raise BankMismatchError(
+                f"bank {bank!r} probe rows are unusable: {e}"
+            ) from e
+        if agreement < self.bank_agreement_min:
+            raise BankMismatchError(
+                f"bank/encoder space-agreement check failed: mean probe "
+                f"cosine {agreement:.4f} < floor "
+                f"{self.bank_agreement_min:.4f} — the bank was not "
+                f"built by this checkpoint's encoder; quarantine the "
+                "pair"
+            )
+        return agreement
 
     def _probe_stats(self, new_engine) -> dict | None:
         """Cosine drift + dispersion of a fixed probe batch, new engine
@@ -462,6 +718,35 @@ class EmbedService:
         trace = self.trace_state()
         if trace is not None:
             out["trace"] = trace
+        if self._knn is not None:
+            out["bank"] = self.bank_info()
+        return out
+
+    def bank_info(self) -> dict:
+        """Which embedding space is this replica answering from? The
+        `GET /admin/bank` payload and the `/stats` bank block (ISSUE
+        16): bank version (step + manifest hash), the checkpoint it was
+        built against, row count, and the last swap generation. A plain
+        --knn-bank npz (no manifest) reports only size + generation."""
+        with self._lock:
+            swaps = self._bank_swaps
+        knn, meta = self._knn, self._bank_meta
+        out: dict = {"configured": knn is not None}
+        if knn is None:
+            return out
+        out.update({
+            "rows": int(len(knn["bank"])),
+            "feat_dim": int(knn["bank"].shape[1]),
+            "generation": self._engine_gen,
+            "swaps": swaps,
+        })
+        if meta is not None:
+            out.update({
+                "bank_step": meta.get("step"),
+                "manifest_sha256": meta.get("manifest_sha256"),
+                "checkpoint_sha256": meta.get("checkpoint_sha256"),
+                "path": meta.get("path"),
+            })
         return out
 
     def trace_state(self) -> dict | None:

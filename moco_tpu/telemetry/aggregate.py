@@ -73,9 +73,11 @@ SLO_KIND = "slo"
 # event names that count as "rollback/NaN trouble" for the default rule
 ROLLBACK_EVENTS = ("rollback", "sentinel")
 # fleet events that count as reload failures (quarantine included: a
-# corrupt export IS a deploy failure even though the fleet survived it)
+# corrupt export IS a deploy failure even though the fleet survived it;
+# a bank-pair quarantine is the ISSUE 16 flavor of the same outcome)
 RELOAD_FAILURE_EVENTS = ("reload_failed", "reload_quarantine",
-                         "reload_watch_error", "reload_bad_layout")
+                         "reload_watch_error", "reload_bad_layout",
+                         "bank_quarantine")
 
 
 # ---------------------------------------------------------------------------
@@ -242,6 +244,7 @@ class RunWindow:
         self.last_router: dict | None = None
         self.last_serve: dict | None = None
         self.last_health: dict | None = None
+        self.last_bank: dict | None = None
 
     # -- ingest --------------------------------------------------------------
     def ingest(self, rec: dict, src: str, path: str, now: float,
@@ -319,6 +322,25 @@ class RunWindow:
                 self.incidents[name] = self.incidents.get(name, 0) + 1
                 if not historical:
                     self._events.append((now, name))
+        elif kind == "bank":
+            # bank lifecycle stream (ISSUE 16): builder progress
+            # (build_start/shard_done/build_done), the service's dual
+            # `swap`, and the fleet's `bank_waiting`/`quarantine`/
+            # `bank_quarantine`/`rollback`. Event names normalize to a
+            # `bank_` prefix so `event:bank_rollback` reads the same
+            # whether the producer already prefixed or not; shard_done
+            # is routine build progress, not an incident
+            name = str(rec.get("event", "unknown"))
+            if not name.startswith("bank"):
+                name = "bank_" + name
+            if name in ("bank_swap", "bank_waiting"):
+                # the records that carry freshness: swap pins age to
+                # step - bank_step, bank_waiting carries age_steps
+                self.last_bank = rec
+            if name != "bank_shard_done":
+                self.incidents[name] = self.incidents.get(name, 0) + 1
+                if not historical:
+                    self._events.append((now, name))
         elif kind == "serve":
             self.last_serve = rec
             if not historical:
@@ -385,7 +407,16 @@ class RunWindow:
                                         ready queue; a sustained high
                                         rate IS a starving train host
           reload_failures               reload_* failure events in window
+                                        (bank_quarantine included: a
+                                        refused pair IS a failed deploy)
           rollback_events               rollback/sentinel events in window
+          bank_age_steps                promoted-checkpoint step minus
+                                        serving-bank step, from the last
+                                        bank swap/bank_waiting record
+                                        (ISSUE 16) — a growing age means
+                                        checkpoints are landing without
+                                        paired banks and the fleet is
+                                        pinned on an aging pair
           resize_relaunches             resize_relaunch records in window
           stale_s                       seconds since the newest record
           event:<name>                  count of that event name in window
@@ -488,6 +519,18 @@ class RunWindow:
                                           window_s, now))
         if name == "rollback_events":
             return float(self.event_count(ROLLBACK_EVENTS, window_s, now))
+        if name == "bank_age_steps":
+            if self.last_bank is None:
+                return None
+            age = self.last_bank.get("age_steps")
+            if isinstance(age, (int, float)):
+                return float(age)
+            step = self.last_bank.get("step")
+            bank_step = self.last_bank.get("bank_step")
+            if (isinstance(step, (int, float))
+                    and isinstance(bank_step, (int, float))):
+                return float(step) - float(bank_step)
+            return None
         if name == "resize_relaunches":
             return float(self.event_count(("resize_relaunch",),
                                           window_s, now))
@@ -524,6 +567,13 @@ class RunWindow:
             snap["events"] = dict(sorted(self.incidents.items()))
         if self.last_health is not None:
             snap["health"] = self.last_health
+        if self.last_bank is not None:
+            snap["bank"] = {
+                k: self.last_bank[k]
+                for k in ("event", "step", "bank_step", "age_steps",
+                          "rows", "generation", "agreement")
+                if k in self.last_bank
+            }
         return snap
 
 
@@ -956,6 +1006,7 @@ class Aggregator:
             incidents, router_g, router_lat, serve_lat = [], [], [], []
             health_g: list = []
             input_stall: list = []
+            bank_age: list = []
             router_counters: dict[str, list] = {}
             for run_id, w in per_run:
                 lab = {"run_id": run_id}
@@ -975,6 +1026,9 @@ class Aggregator:
                 v = w.metric("input_credit_stall_rate", 300.0, now)
                 if v is not None:
                     input_stall.append((lab, v))
+                v = w.metric("bank_age_steps", 300.0, now)
+                if v is not None:
+                    bank_age.append((lab, v))
                 if w.last_health:
                     for key in sorted(w.last_health):
                         v = w.metric(f"health:{key}", 300.0, now)
@@ -1026,6 +1080,9 @@ class Aggregator:
         emit("moco_tpu_input_credit_stall_rate", "gauge",
              "windowed (300s) fraction of wall time the train host spent "
              "blocked on an empty input ready queue", input_stall)
+        emit("moco_tpu_bank_age_steps", "gauge",
+             "promoted-checkpoint step minus serving kNN-bank step "
+             "(last bank swap/bank_waiting record)", bank_age)
         emit("moco_tpu_run_stale_seconds", "gauge",
              "seconds since the run's newest record was observed", stale)
         emit("moco_tpu_events_total", "counter",

@@ -1,0 +1,1127 @@
+"""Versioned bank lifecycle suite (ISSUE 16).
+
+Five layers:
+  - builder units (no jax): shard→merge bit-identical for any shard
+    count, resume-from-completed-shards after a crash, retry-on-another
+    -shard, manifest schema + atomicity, probe agreement roundtrip;
+  - CLI: tools/bank_build.py config-error exits (45) and the jax-free
+    batch-lane build through a stub /v1/embed fleet, with kind:"bank"
+    telemetry;
+  - service dual swap on jax-free stub engines: the HTTP wire contract
+    (409 reload_refused with the serving bank's step, 503 for an
+    in-flight bank, 409 reload_bank_mismatch for a doctored pair,
+    GET /admin/bank), and the closed-loop generation-consistency drill
+    — every served row matches the engine generation that produced it;
+  - fleet promotion units (stub backends, no jax): pair gating
+    (bank_waiting), the dual-swap POST carrying (bank, bank_step), and
+    the mismatch drill — pair quarantined as a unit, last-known-good
+    restored, half-swapped replicas rolled back;
+  - in-process jax: a verified (checkpoint, bank) pair swaps with
+    embeddings bit-identical to a cold start, a doctored manifest is
+    refused by the space-agreement probe; plus the full promotion soak
+    (slow) over real tools/serve.py replicas.
+
+obsd/report satellites ride along: bank event normalization,
+bank_age_steps, the shipped SLO rules, and the report's bank section.
+"""
+
+from __future__ import annotations
+
+import base64
+import importlib.util
+import json
+import os
+import shutil
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from moco_tpu.resilience.integrity import manifest_path, write_manifest
+from moco_tpu.serve.bankbuild import (
+    BankBuildError,
+    build_bank,
+    load_bank,
+    probe_agreement,
+    read_bank_meta,
+    shard_ranges,
+    verify_bank,
+)
+from moco_tpu.serve.fleet import FleetPolicy, FleetSupervisor, ReplicaState
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+D = 6  # stub embedding dim
+
+
+def _embed_stub(batch, scale=1.0):
+    flat = np.asarray(batch, np.float32).reshape(len(batch), -1)
+    return (flat[:, :D] / 255.0 * scale).astype(np.float32)
+
+
+def _corpus(n=13, seed=3, size=8):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, (n, size, size, 3), dtype=np.uint8)
+    labels = (np.arange(n) % 3).astype(np.int64)
+    return images, labels
+
+
+def _ckpt(tmp_path, step, payload=b"weights " * 64):
+    d = tmp_path / "export" / str(step)
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / "encoder.npz"
+    path.write_bytes(payload)
+    return str(path)
+
+
+def _post(url, body, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, timeout=10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _wait(cond, timeout_s=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# builder: deterministic shard -> merge, resume, retry (no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_shard_ranges_partition_exactly():
+    for n, shards in ((13, 3), (4, 4), (7, 1), (5, 9)):
+        ranges = shard_ranges(n, shards)
+        covered = [i for (s, e) in ranges for i in range(s, e)]
+        assert covered == list(range(n))
+    with pytest.raises(ValueError, match="shards"):
+        shard_ranges(4, 0)
+
+
+def test_build_bytes_identical_across_shard_counts(tmp_path):
+    """ISSUE 16 acceptance: a 1-shard and a 3-shard build of the same
+    corpus produce byte-identical bank.npz files and manifests equal
+    modulo the recorded shard topology — merge order is dataset-index
+    order, never worker-completion order."""
+    images, labels = _corpus(13)
+    ck = _ckpt(tmp_path, 7)
+    events = []
+    m1 = build_bank(str(tmp_path / "b1"), 7, images, labels, _embed_stub,
+                    checkpoint_path=ck, image_size=8, shards=1)
+    m3 = build_bank(str(tmp_path / "b3"), 7, images, labels, _embed_stub,
+                    checkpoint_path=ck, image_size=8, shards=3, workers=2,
+                    emit=lambda e, **f: events.append((e, f)))
+    p1 = tmp_path / "b1" / "7" / "bank.npz"
+    p3 = tmp_path / "b3" / "7" / "bank.npz"
+    assert p1.read_bytes() == p3.read_bytes()
+    assert m1["shards"] == 1 and m3["shards"] == 3
+    strip = lambda m: {k: v for k, v in m.items() if k != "shards"}  # noqa: E731
+    assert strip(m1) == strip(m3)
+    assert m1["files"]["bank.npz"]["sha256"] == \
+        m3["files"]["bank.npz"]["sha256"]
+    # telemetry: one build_start, one shard_done per shard, one build_done
+    names = [e for e, _ in events]
+    assert names[0] == "build_start" and names[-1] == "build_done"
+    assert names.count("shard_done") == 3
+    assert events[0][1]["checkpoint_sha256"] == m3["checkpoint"]["sha256"]
+    # the artifact is complete: integrity-verifiable, loadable, probed
+    assert verify_bank(str(tmp_path / "b3"), 7) is None
+    feats, lab, meta = load_bank(str(p3))
+    assert feats.shape == (13, D) and np.array_equal(lab, labels)
+    assert meta["step"] == 7 and meta["rows"] == 13
+    assert probe_agreement(_embed_stub, meta) == pytest.approx(1.0)
+    # .build scratch is gone; the manifest was written last
+    assert not (tmp_path / "b3" / ".build" / "7").exists()
+
+
+def test_build_resumes_from_completed_shards(tmp_path):
+    """Killed-mid-build acceptance: a build that dies on one shard keeps
+    its completed shard files; the rerun re-embeds ONLY the missing
+    shard and lands byte-identical to a never-crashed build."""
+    images, labels = _corpus(12)
+    ck = _ckpt(tmp_path, 9)
+    poison = images[4]  # first row of shard 1 of 3
+
+    def dying(batch):
+        if np.array_equal(np.asarray(batch)[0], poison):
+            raise RuntimeError("worker died")
+        return _embed_stub(batch)
+
+    with pytest.raises(BankBuildError, match=r"shard 1 rows \[4:8\)"):
+        build_bank(str(tmp_path / "b"), 9, images, labels, dying,
+                   checkpoint_path=ck, image_size=8, shards=3,
+                   max_shard_retries=2)
+    work = tmp_path / "b" / ".build" / "9"
+    assert sorted(os.listdir(work)) == [
+        "shard_00000000_00000004.npz", "shard_00000008_00000012.npz",
+    ]
+    assert not os.path.exists(manifest_path(str(tmp_path / "b"), 9))
+
+    calls = []
+
+    def counting(batch):
+        calls.append(len(batch))
+        return _embed_stub(batch)
+
+    events = []
+    build_bank(str(tmp_path / "b"), 9, images, labels, counting,
+               checkpoint_path=ck, image_size=8, shards=3,
+               emit=lambda e, **f: events.append((e, f)))
+    reused = [f for e, f in events if e == "shard_done" and f["reused"]]
+    fresh = [f for e, f in events if e == "shard_done" and not f["reused"]]
+    assert len(reused) == 2 and len(fresh) == 1 and fresh[0]["shard"] == 1
+    # only the missing shard (1 batch) + the probe batch were embedded
+    assert len(calls) == 2
+    clean = build_bank(str(tmp_path / "clean"), 9, images, labels,
+                       _embed_stub, checkpoint_path=ck, image_size=8,
+                       shards=3)
+    assert (tmp_path / "b" / "9" / "bank.npz").read_bytes() == \
+        (tmp_path / "clean" / "9" / "bank.npz").read_bytes()
+    with open(manifest_path(str(tmp_path / "b"), 9)) as f:
+        resumed_manifest = json.load(f)
+    assert resumed_manifest == clean  # byte-identical artifact, same binding
+
+
+def test_build_retries_shard_on_transient_failure(tmp_path):
+    images, labels = _corpus(8)
+    ck = _ckpt(tmp_path, 5)
+    failed = []
+
+    def flaky(batch):
+        if np.asarray(batch).shape[0] == 4 and not failed:
+            failed.append(1)
+            raise OSError("connection reset")  # a dead batch-lane worker
+        return _embed_stub(batch)
+
+    manifest = build_bank(str(tmp_path / "b"), 5, images, labels, flaky,
+                          checkpoint_path=ck, image_size=8, shards=2,
+                          workers=2)
+    assert manifest["rows"] == 8 and failed  # it DID fail once
+    assert verify_bank(str(tmp_path / "b"), 5) is None
+
+
+def test_build_input_validation_and_legacy_load(tmp_path):
+    images, labels = _corpus(4)
+    ck = _ckpt(tmp_path, 3)
+    with pytest.raises(BankBuildError, match="corpus shape mismatch"):
+        build_bank(str(tmp_path / "b"), 3, images, labels[:2],
+                   _embed_stub, checkpoint_path=ck, image_size=8)
+    with pytest.raises(BankBuildError, match="empty corpus"):
+        build_bank(str(tmp_path / "b"), 3, images[:0], labels[:0],
+                   _embed_stub, checkpoint_path=ck, image_size=8)
+    with pytest.raises(BankBuildError, match=r"\[N, D\]|rows"):
+        build_bank(str(tmp_path / "b"), 3, images, labels,
+                   lambda b: np.zeros(3, np.float32),
+                   checkpoint_path=ck, image_size=8, max_shard_retries=1)
+    # a plain npz (pre-ISSUE-16 --knn-bank) loads with meta=None
+    legacy = tmp_path / "legacy.npz"
+    np.savez(legacy, features=np.ones((4, D), np.float32),
+             labels=np.arange(4))
+    feats, lab, meta = load_bank(str(legacy))
+    assert feats.shape == (4, D) and meta is None
+    with pytest.raises(ValueError, match="features"):
+        np.savez(tmp_path / "bad.npz", nope=np.ones(3))
+        load_bank(str(tmp_path / "bad.npz"))
+    # a versioned layout WITHOUT its manifest is "still in flight"
+    step_dir = tmp_path / "b2" / "11"
+    step_dir.mkdir(parents=True)
+    np.savez(step_dir / "bank.npz", features=np.ones((2, D), np.float32),
+             labels=np.arange(2))
+    assert read_bank_meta(str(step_dir / "bank.npz")) is None
+
+
+# ---------------------------------------------------------------------------
+# tools/bank_build.py CLI (config errors + the jax-free batch lane)
+# ---------------------------------------------------------------------------
+
+
+def test_bank_build_cli_config_errors(tmp_path):
+    bank_build = _load_tool("bank_build")
+    images, labels = _corpus(4)
+    corpus = tmp_path / "corpus.npz"
+    np.savez(corpus, images=images, labels=labels)
+    ck = _ckpt(tmp_path, 7)
+    base = ["--bank-dir", str(tmp_path / "b"), "--corpus", str(corpus)]
+    # missing checkpoint file
+    assert bank_build.main(
+        ["--checkpoint", str(tmp_path / "nope.npz"), "--step", "1"] + base
+    ) == 45
+    # --step -1 with a non-step parent dir
+    loose = tmp_path / "loose.npz"
+    loose.write_bytes(b"w")
+    assert bank_build.main(["--checkpoint", str(loose)] + base) == 45
+    # corpus without labels
+    np.savez(tmp_path / "bad_corpus.npz", images=images)
+    assert bank_build.main(
+        ["--checkpoint", ck, "--bank-dir", str(tmp_path / "b"),
+         "--corpus", str(tmp_path / "bad_corpus.npz")]
+    ) == 45
+
+
+def test_bank_build_cli_batch_lane_with_telemetry(tmp_path):
+    """The jax-free lane: the CLI embeds through a (stub) serve fleet's
+    POST /v1/embed, derives --step from the export layout, and lands
+    kind:"bank" build events in events.jsonl."""
+    bank_build = _load_tool("bank_build")
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(n))
+            row = _embed_stub(np.asarray(req["pixels"], np.uint8)[None])[0]
+            body = json.dumps({"embedding": row.tolist()}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    class S(ThreadingHTTPServer):
+        daemon_threads = True
+
+    srv = S(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        images, labels = _corpus(6)
+        corpus = tmp_path / "corpus.npz"
+        np.savez(corpus, images=images, labels=labels)
+        ck = _ckpt(tmp_path, 7000)
+        tdir = tmp_path / "t"
+        rc = bank_build.main([
+            "--checkpoint", ck, "--bank-dir", str(tmp_path / "bank"),
+            "--corpus", str(corpus), "--shards", "2",
+            "--fleet-url", f"http://127.0.0.1:{srv.server_address[1]}",
+            "--telemetry-dir", str(tdir),
+        ])
+        assert rc == 0
+        assert verify_bank(str(tmp_path / "bank"), 7000) is None
+        feats, _, meta = load_bank(
+            str(tmp_path / "bank" / "7000" / "bank.npz"))
+        assert np.array_equal(feats, _embed_stub(images))
+        assert meta["step"] == 7000  # derived from the export layout
+        with open(tdir / "events.jsonl") as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+        bank_events = [r["event"] for r in recs if r.get("kind") == "bank"]
+        assert bank_events[0] == "build_start"
+        assert bank_events[-1] == "build_done"
+        assert bank_events.count("shard_done") == 2
+        assert len({r["run_id"] for r in recs if r.get("kind") == "bank"}) == 1
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# service dual swap on stub engines: wire contract + generation drill
+# ---------------------------------------------------------------------------
+
+
+class _SpaceStubEngine:
+    """A jax-free engine whose embedding space is a scaled pixel
+    projection: scale 1.0 and 2.0 are distinguishable spaces with
+    cosine 1.0 — the space-agreement probe passes, while every served
+    row still reveals WHICH engine generation computed it."""
+
+    image_size = 8
+    buckets = (1, 4)
+
+    def __init__(self, scale):
+        self.scale = float(scale)
+
+    def warmup(self):
+        return D
+
+    def embed(self, images_u8):
+        return _embed_stub(images_u8, scale=self.scale)
+
+
+def _stub_pair(tmp_path, step, scale, name):
+    """A (checkpoint file, versioned bank) pair for _SpaceStubEngine."""
+    ck = _ckpt(tmp_path / name, step, payload=name.encode() * 100)
+    images, labels = _corpus(8, seed=step)
+    build_bank(str(tmp_path / name / "bank"), step, images, labels,
+               lambda b: _embed_stub(b, scale=scale),
+               checkpoint_path=ck, image_size=8)
+    return ck, str(tmp_path / name / "bank" / str(step) / "bank.npz")
+
+
+def _stub_service(ck1_bank, scale=1.0, **kw):
+    from moco_tpu.serve import EmbedService
+
+    feats, labels, meta = load_bank(ck1_bank)
+    service = EmbedService(
+        _SpaceStubEngine(scale), flush_ms=1.0, max_queue=64,
+        request_deadline_ms=30_000.0, knn_bank=feats, knn_labels=labels,
+        knn_k=3, knn_bank_meta=meta, **kw,
+    )
+    return service
+
+
+def test_dual_swap_http_contract_and_admin_bank(tmp_path):
+    """The wire satellites: 409 reload_refused names tools/bank_build.py
+    and carries the serving bank's step; a manifest-less bank is 503
+    (retryable, build in flight); a wrong-checkpoint pair is 409
+    reload_bank_mismatch; a verified pair swaps and GET /admin/bank +
+    /stats report the new bank version."""
+    from moco_tpu.serve import ServeFrontend
+
+    ck1, bank1 = _stub_pair(tmp_path, 1, 1.0, "one")
+    ck2, bank2 = _stub_pair(tmp_path, 2, 2.0, "two")
+    service = _stub_service(bank1)
+    service.set_engine_factory(lambda path: _SpaceStubEngine(2.0))
+    frontend = ServeFrontend(service, port=0)
+    frontend.start()
+    try:
+        status, resp = _get(frontend.url + "/admin/bank")
+        assert status == 200 and resp["configured"]
+        assert resp["bank_step"] == 1 and resp["rows"] == 8
+        assert resp["generation"] == 0 and resp["swaps"] == 0
+
+        # bank-less reload under a configured bank: terminal 409 that
+        # tells the operator exactly what to build
+        status, resp = _post(frontend.url + "/admin/reload",
+                             {"pretrained": ck2})
+        assert status == 409 and resp["error"] == "reload_refused"
+        assert "tools/bank_build.py" in resp["detail"]
+        assert resp["bank_step"] == 1  # the space still being served
+
+        # manifest-less bank: the build may still be in flight -> 503
+        inflight_dir = tmp_path / "inflight" / "2"
+        inflight_dir.mkdir(parents=True)
+        shutil.copy(bank2, inflight_dir / "bank.npz")
+        status, resp = _post(
+            frontend.url + "/admin/reload",
+            {"pretrained": ck2, "bank": str(inflight_dir / "bank.npz"),
+             "bank_step": 2})
+        assert status == 503 and resp["error"] == "reload_failed"
+        assert "in flight" in resp["detail"]
+
+        # bank1 is bound to checkpoint 1's hash: offering it with
+        # checkpoint 2 is NOT a pair -> 409 reload_bank_mismatch
+        status, resp = _post(
+            frontend.url + "/admin/reload",
+            {"pretrained": ck2, "bank": bank1, "bank_step": 1})
+        assert status == 409 and resp["error"] == "reload_bank_mismatch"
+        assert "not a pair" in resp["detail"]
+
+        # the verified pair swaps in one generation bump
+        status, resp = _post(
+            frontend.url + "/admin/reload",
+            {"pretrained": ck2, "step": 2, "bank": bank2,
+             "bank_step": 2})
+        assert status == 200 and resp["status"] == "reloaded"
+        assert resp["bank_step"] == 2 and resp["bank_rows"] == 8
+        assert resp["bank_agreement"] == pytest.approx(1.0)
+
+        img = np.full((8, 8, 3), 100, np.uint8)
+        body = {"image_b64": base64.b64encode(img.tobytes()).decode(),
+                "shape": list(img.shape)}
+        status, resp = _post(frontend.url + "/v1/embed", body)
+        assert status == 200
+        assert np.allclose(resp["embedding"],
+                           _embed_stub(img[None], scale=2.0)[0])
+        status, resp = _post(frontend.url + "/v1/knn", body)
+        assert status == 200 and resp["class"] in (0, 1, 2)
+
+        status, resp = _get(frontend.url + "/admin/bank")
+        assert resp["bank_step"] == 2 and resp["swaps"] == 1
+        assert resp["generation"] == 1
+        status, stats = _get(frontend.url + "/stats")
+        assert stats["bank"]["bank_step"] == 2
+    finally:
+        service.drain(timeout_s=10.0)
+        frontend.shutdown()
+
+
+def test_dual_swap_closed_loop_generation_consistent(tmp_path):
+    """The acceptance drill, deterministically: under closed-loop load
+    across a dual swap, zero requests are lost and EVERY returned row
+    matches the engine generation that computed it — no cross-space
+    answers, ever. The scaled stub spaces make a violation visible in
+    the row values themselves."""
+    ck1, bank1 = _stub_pair(tmp_path, 1, 1.0, "one")
+    ck2, bank2 = _stub_pair(tmp_path, 2, 2.0, "two")
+    service = _stub_service(bank1)
+    service.set_engine_factory(lambda path: _SpaceStubEngine(2.0))
+    try:
+        stop = threading.Event()
+        results, errors = [], []
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 256, (64, 8, 8, 3), dtype=np.uint8)
+
+        def client(seed):
+            i = seed
+            while not stop.is_set():
+                img = imgs[i % len(imgs)]
+                i += 1
+                try:
+                    row, _ = service.embed(img)
+                except Exception as e:  # pragma: no cover - fails the test
+                    errors.append(e)
+                    return
+                results.append((img, np.asarray(row, np.float32),
+                                getattr(row, "gen", 0)))
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        entry = service.reload(ck2, step=2, bank=bank2, bank_step=2)
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert not errors
+        assert entry["bank_agreement"] == pytest.approx(1.0)
+        by_gen = {0: 0, 1: 0}
+        for img, row, gen in results:
+            scale = {0: 1.0, 1: 2.0}[gen]
+            assert np.allclose(row, _embed_stub(img[None], scale=scale)[0]), \
+                f"generation {gen} row does not match its engine's space"
+            by_gen[gen] += 1
+        # the loop really straddled the swap: both generations answered
+        assert by_gen[0] > 0 and by_gen[1] > 0
+        # classify resolves post-swap rows against the NEW bank
+        cls_id, _, _ = service.classify(imgs[0])
+        assert cls_id in (0, 1, 2)
+    finally:
+        service.drain(timeout_s=10.0)
+
+
+def test_doctored_manifest_refused_by_space_agreement(tmp_path):
+    """A bank whose manifest LIES about its probe features (right
+    checkpoint hash, wrong recorded space) is exactly what the
+    agreement probe exists for: BankMismatchError, factory cost only,
+    old pair untouched."""
+    from moco_tpu.serve import BankMismatchError
+
+    ck1, bank1 = _stub_pair(tmp_path, 1, 1.0, "one")
+    ck2, bank2 = _stub_pair(tmp_path, 2, 2.0, "two")
+    mpath = manifest_path(str(tmp_path / "two" / "bank"), 2)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest["probe"]["features"] = [
+        [-x for x in row] for row in manifest["probe"]["features"]
+    ]
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    # the doctored manifest still passes FILE integrity (bank.npz is
+    # untouched) — only the probe can catch it
+    assert verify_bank(str(tmp_path / "two" / "bank"), 2) is None
+
+    service = _stub_service(bank1)
+    service.set_engine_factory(lambda path: _SpaceStubEngine(2.0))
+    try:
+        before, _ = service.embed(np.zeros((8, 8, 3), np.uint8))
+        with pytest.raises(BankMismatchError,
+                           match="space-agreement"):
+            service.reload(ck2, step=2, bank=bank2, bank_step=2)
+        after, _ = service.embed(np.full((8, 8, 3), 10, np.uint8))
+        assert service.reloads == 0  # old pair keeps serving
+        assert service.bank_info()["bank_step"] == 1
+
+        # offered bank_step contradicting the manifest: refused before
+        # the factory ever runs
+        service.set_engine_factory(
+            lambda path: (_ for _ in ()).throw(AssertionError("no factory")))
+        fixed_ck, fixed_bank = _stub_pair(tmp_path, 4, 2.0, "four")
+        with pytest.raises(BankMismatchError, match="recorded step"):
+            service.reload(fixed_ck, bank=fixed_bank, bank_step=999)
+    finally:
+        service.drain(timeout_s=10.0)
+
+
+# ---------------------------------------------------------------------------
+# fleet promotion: pair gating, dual-swap POST, quarantine + rollback
+# ---------------------------------------------------------------------------
+
+
+FAST_POLICY = dict(
+    probe_secs=0.1, probe_timeout_s=0.5, health_stale_secs=1.0,
+    startup_grace_secs=15.0, term_grace_secs=1.0,
+    backoff_base_secs=0.05, backoff_max_secs=0.2, backoff_jitter=0.0,
+    request_timeout_s=10.0, watch_poll_secs=0.1, stats_every_secs=1.0,
+)
+
+
+class _FakeProc:
+    pid = 4242
+
+    def poll(self):
+        return None
+
+    def terminate(self):
+        pass
+
+
+def _capture_backend(decide):
+    """An in-thread replica stub: records every POST body, answers with
+    decide(body) -> (status, payload)."""
+    bodies = []
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(n)
+            try:
+                req = json.loads(raw) if raw else {}
+            except ValueError:
+                req = {}
+            bodies.append(dict(req, _path=self.path))
+            status, payload = decide(req)
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    class S(ThreadingHTTPServer):
+        daemon_threads = True
+
+    srv = S(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, bodies
+
+
+def _bank_fleet(tmp_path, ports, bank_dir):
+    fleet = FleetSupervisor(
+        lambda *a: ["true"], replicas=len(ports),
+        telemetry_dir=str(tmp_path / "fleet_t"),
+        policy=FleetPolicy(**FAST_POLICY), bank_dir=bank_dir,
+    )
+    for i, port in enumerate(ports):
+        r = ReplicaState(i, "127.0.0.1", port,
+                         str(tmp_path / f"r{i}"), budget=3)
+        r.proc = _FakeProc()
+        r.healthy = True
+        fleet.replicas.append(r)
+    return fleet
+
+
+def _fleet_bank(bank_dir, step, rows=6):
+    """A verified bank artifact in the fleet's bank_dir layout."""
+    step_dir = os.path.join(bank_dir, str(step))
+    os.makedirs(step_dir)
+    np.savez(os.path.join(step_dir, "bank.npz"),
+             features=np.full((rows, D), float(step), np.float32),
+             labels=np.arange(rows) % 2)
+    write_manifest(bank_dir, step)
+    return os.path.join(step_dir, "bank.npz")
+
+
+def test_fleet_pair_gating_waits_for_bank_then_dual_swaps(tmp_path):
+    """With --bank-dir, a manifested checkpoint WAITS (deduped
+    bank_waiting) until its paired bank lands; the reload POST then
+    carries (bank, bank_step) so the replica rolls both together."""
+    srv, bodies = _capture_backend(
+        lambda b: (200, {"status": "reloaded"}))
+    bank_dir = str(tmp_path / "bank")
+    os.makedirs(bank_dir)
+    fleet = _bank_fleet(tmp_path, [srv.server_address[1]], bank_dir)
+    try:
+        with fleet._lock:
+            fleet._target_step, fleet._target_path = 7, "/x/7/encoder.npz"
+        fleet._reload_sync()
+        fleet._reload_sync()  # the converge loop coming around again
+        assert bodies == []  # no replica was asked to half-swap
+        assert fleet.replicas[0].deployed_step == -1
+        waiting = [e for e in fleet.incidents
+                   if e["event"] == "bank_waiting"]
+        assert len(waiting) == 1  # announced once, not every pass
+        assert waiting[0]["step"] == 7
+
+        bank_path = _fleet_bank(bank_dir, 7)
+        fleet._reload_sync()
+        assert fleet.replicas[0].deployed_step == 7
+        assert bodies[-1]["bank"] == bank_path
+        assert bodies[-1]["bank_step"] == 7
+        st = fleet.stats()["bank"]
+        assert st["good_step"] == 7 and st["good_bank"] == bank_path
+        # a corrupt LATER bank quarantines itself without touching the
+        # serving pair
+        bank9 = _fleet_bank(bank_dir, 9)
+        with open(bank9, "ab") as f:
+            f.write(b"torn")
+        with fleet._lock:
+            fleet._target_step, fleet._target_path = 9, "/x/9/encoder.npz"
+        fleet._reload_sync()
+        assert fleet.replicas[0].deployed_step == 7
+        assert os.path.isdir(os.path.join(bank_dir, ".quarantine", "9"))
+        assert fleet.stats()["bank"]["quarantined"] == [9]
+    finally:
+        srv.shutdown()
+
+
+def test_fleet_mismatch_quarantines_pair_and_rolls_back(tmp_path):
+    """The mismatch drill: replica 0 swaps onto the new pair, replica 1
+    refuses it (space-agreement). The pair is quarantined as a unit,
+    known-good rolls back to the previous pair, and the half-swapped
+    replica is reloaded back — the fleet converges on the old space."""
+    srv0, bodies0 = _capture_backend(
+        lambda b: (200, {"status": "reloaded"}))
+
+    def judge(b):
+        if b.get("bank_step") == 5:
+            return 200, {"status": "reloaded"}
+        return 409, {"error": "reload_bank_mismatch",
+                     "detail": "space-agreement probe cosine 0.01"}
+
+    srv1, bodies1 = _capture_backend(judge)
+    bank_dir = str(tmp_path / "bank")
+    os.makedirs(bank_dir)
+    bank5 = _fleet_bank(bank_dir, 5)
+    _fleet_bank(bank_dir, 7)
+    fleet = _bank_fleet(
+        tmp_path, [srv0.server_address[1], srv1.server_address[1]],
+        bank_dir)
+    try:
+        with fleet._lock:
+            fleet._target_step, fleet._target_path = 5, "/x/5/encoder.npz"
+        fleet._reload_sync()
+        assert all(r.deployed_step == 5 for r in fleet.replicas)
+        assert fleet.stats()["bank"]["good_step"] == 5
+
+        with fleet._lock:
+            fleet._target_step, fleet._target_path = 7, "/x/7/encoder.npz"
+        fleet._reload_sync()
+        # replica 0 half-swapped onto 7, then was rolled back to the
+        # restored known-good pair
+        assert fleet.replicas[0].deployed_step == 5
+        assert fleet.replicas[1].deployed_step == 5
+        assert bodies0[-1]["pretrained"] == "/x/5/encoder.npz"
+        assert bodies0[-1]["bank"] == bank5 and bodies0[-1]["bank_step"] == 5
+        # the pair died as a unit
+        assert os.path.isdir(os.path.join(bank_dir, ".quarantine", "7"))
+        assert not os.path.exists(manifest_path(bank_dir, 7))
+        st = fleet.stats()["bank"]
+        assert st["good_step"] == 5 and st["good_bank"] == bank5
+        assert st["quarantined"] == [7]
+        # the refusal is terminal for step 7 and the target was reset:
+        # the converge loop must not churn on the condemned pair
+        assert fleet.replicas[1].reload_refused_step == 7
+        with fleet._lock:
+            assert fleet._target_path is None
+        n_posts = len(bodies0) + len(bodies1)
+        fleet._reload_sync()
+        assert len(bodies0) + len(bodies1) == n_posts
+        events = [e["event"] for e in fleet.incidents]
+        assert "quarantine" in events and "bank_quarantine" in events
+        rollbacks = [e for e in fleet.incidents if e["event"] == "rollback"]
+        assert rollbacks and rollbacks[0]["mode"] == "reload"
+        assert rollbacks[0]["from_step"] == 7
+        assert rollbacks[0]["to_step"] == 5
+        assert all(e["kind"] == "bank" for e in fleet.incidents
+                   if e["event"] in ("quarantine", "bank_quarantine",
+                                     "rollback", "bank_waiting"))
+    finally:
+        srv0.shutdown()
+        srv1.shutdown()
+
+
+def test_fleet_launch_argv_pins_bank_and_tolerates_legacy_signature(
+        tmp_path):
+    """A replica relaunch pins the known-good BANK into the child argv
+    alongside the weights (a dying replica reboots onto the pair, never
+    new weights over an old bank); a legacy 4-arg child_argv still
+    launches (bank-free fleets, older stubs)."""
+    calls = []
+
+    def argv5(index, port, tdir, pretrained, bank):
+        calls.append((pretrained, bank))
+        return ["true"]
+
+    def argv4(index, port, tdir, pretrained):
+        calls.append((pretrained, None))
+        return ["true"]
+
+    for i, fn in enumerate((argv5, argv4)):
+        fleet = FleetSupervisor(fn, replicas=1,
+                                telemetry_dir=str(tmp_path / f"t{i}"),
+                                policy=FleetPolicy(**FAST_POLICY))
+        with fleet._lock:
+            fleet._current_pretrained = "/good/encoder.npz"
+            fleet._good_bank = "/good/bank.npz"
+        r = ReplicaState(0, "127.0.0.1", 1234, str(tmp_path / f"r{i}"),
+                         budget=3)
+        os.makedirs(r.telemetry_dir, exist_ok=True)
+        fleet._launch(r)
+        r.proc.wait(timeout=10.0)
+    assert calls == [("/good/encoder.npz", "/good/bank.npz"),
+                     ("/good/encoder.npz", None)]
+
+
+# ---------------------------------------------------------------------------
+# obsd + SLO rules + telemetry report satellites
+# ---------------------------------------------------------------------------
+
+
+def _bank_rec(event, **fields):
+    return dict({"v": 1, "kind": "bank", "event": event}, **fields)
+
+
+def test_run_window_bank_events_and_age():
+    from moco_tpu.telemetry.aggregate import RunWindow
+
+    w = RunWindow("r1")
+    w.ingest(_bank_rec("build_start", step=7), "s", "p", 10.0)
+    w.ingest(_bank_rec("shard_done", step=7, shard=0), "s", "p", 10.5)
+    w.ingest(_bank_rec("swap", step=7, bank_step=5, rows=8,
+                       generation=1, agreement=0.995), "s", "p", 11.0)
+    # event names normalize to a bank_ prefix; shard_done stays out of
+    # the incident ledger (it is progress, not an incident)
+    assert w.incidents.get("bank_build_start") == 1
+    assert w.incidents.get("bank_swap") == 1
+    assert "bank_shard_done" not in w.incidents
+    assert w.metric("event:bank_swap", 60.0, 12.0) == 1.0
+    # bank age: promoted checkpoint step minus serving bank step
+    assert w.metric("bank_age_steps", 60.0, 12.0) == 2.0
+    w.ingest(_bank_rec("bank_waiting", step=9, age_steps=4), "s", "p", 12.0)
+    assert w.metric("bank_age_steps", 60.0, 13.0) == 4.0
+    w.ingest(_bank_rec("quarantine", step=9), "s", "p", 13.0)
+    w.ingest(_bank_rec("rollback", replica=0, from_step=9, to_step=5),
+             "s", "p", 14.0)
+    assert w.metric("event:bank_quarantine", 60.0, 15.0) == 1.0
+    assert w.metric("event:bank_rollback", 60.0, 15.0) == 1.0
+    # a quarantined pair counts as a reload failure for the default rule
+    assert w.metric("reload_failures", 60.0, 15.0) == 1.0
+    snap = w.snapshot(15.0)
+    assert snap["bank"]["event"] == "bank_waiting"
+    assert snap["bank"]["age_steps"] == 4
+    # no bank records ever seen -> no fabricated age
+    w2 = RunWindow("r2")
+    assert w2.metric("bank_age_steps", 60.0, 15.0) is None
+
+
+def test_shipped_bank_slo_rules_fire():
+    from moco_tpu.telemetry.aggregate import RunWindow, SLOEngine, load_rules
+
+    rules = load_rules(
+        os.path.join(REPO, "tools", "slo_rules", "bank_lifecycle.json"))
+    assert [r.name for r in rules] == [
+        "bank_age", "bank_pair_quarantine", "bank_rollback"]
+    w = RunWindow("r1")
+    w.ingest(_bank_rec("bank_waiting", step=9000, age_steps=3000),
+             "s", "p", 100.0)
+    w.ingest(_bank_rec("quarantine", step=9000), "s", "p", 100.5)
+    w.ingest(_bank_rec("rollback", replica=1, from_step=9000, to_step=5),
+             "s", "p", 101.0)
+    engine = SLOEngine(rules)
+    fired = {t["rule"] for t in engine.evaluate({"r1": w}, 102.0)}
+    assert fired == {"bank_age", "bank_pair_quarantine", "bank_rollback"}
+
+
+def test_report_bank_section(tmp_path):
+    report = _load_tool("telemetry_report")
+    records = [
+        _bank_rec("build_start", step=7, rows=128, shards=2),
+        _bank_rec("shard_done", step=7, shard=0),
+        _bank_rec("shard_done", step=7, shard=1),
+        _bank_rec("build_done", step=7, rows=128, feat_dim=64, shards=2,
+                  manifest_sha256="ab" * 32),
+        _bank_rec("swap", step=9, bank_step=7, rows=128, generation=2,
+                  agreement=0.998),
+        _bank_rec("quarantine", step=11, detail="space mismatch"),
+        _bank_rec("rollback", replica=0, from_step=11, to_step=9),
+    ]
+    summary = report.summarize(records)
+    bank = summary["bank"]
+    assert bank["builds"] == 1 and bank["swaps"] == 1
+    assert bank["quarantines"] == 1 and bank["rollbacks"] == 1
+    assert bank["events"]["bank_shard_done"] == 2
+    assert bank["last_build"]["rows"] == 128
+    assert bank["last_swap"]["bank_step"] == 7
+    assert bank["age_steps"] == 2
+    rendered = report.render(summary)
+    assert "bank:" in rendered
+    assert "128 rows" in rendered and "generation 2" in rendered
+    # --follow line rendering
+    line = report.render_record(records[4])
+    assert line.startswith("bank: swap") and "bank_step=7" in line
+
+
+# ---------------------------------------------------------------------------
+# in-process jax: the verified pair swaps bit-identically
+# ---------------------------------------------------------------------------
+
+
+J_SIZE = 32
+J_BUCKETS = (1, 4)
+
+
+@pytest.fixture(scope="module")
+def pair_exports(tmp_path_factory):
+    """Two DIFFERENT tiny encoders in the torchvision dialect — the
+    (checkpoint, bank) pair for A serves first, the pair for B rolls
+    over it."""
+    import jax
+    import jax.numpy as jnp
+
+    from moco_tpu.checkpoint import _save_flat, resnet_to_torchvision
+    from moco_tpu.models import build_backbone
+
+    model = build_backbone("resnet_tiny", cifar_stem=True)
+    root = tmp_path_factory.mktemp("bank_exports")
+    paths = []
+    for seed in (0, 1):
+        variables = model.init(
+            jax.random.key(seed), jnp.zeros((1, J_SIZE, J_SIZE, 3)),
+            train=False,
+        )
+        flat = resnet_to_torchvision(
+            jax.tree.map(np.asarray, variables["params"]),
+            jax.tree.map(np.asarray, variables.get("batch_stats", {})),
+            prefix="module.encoder_q.",
+        )
+        path = str(root / f"encoder_{seed}.npz")
+        _save_flat(flat, path)
+        paths.append(path)
+    return paths
+
+
+def _jax_engine(path):
+    from moco_tpu.serve import EmbeddingEngine
+
+    return EmbeddingEngine.from_checkpoint(
+        path, "resnet_tiny", image_size=J_SIZE, cifar_stem=True,
+        buckets=J_BUCKETS,
+    )
+
+
+def _jax_embed_fn(engine):
+    cap = J_BUCKETS[-1]
+
+    def embed(batch):
+        return np.concatenate(
+            [engine.embed(batch[lo:lo + cap])
+             for lo in range(0, len(batch), cap)], axis=0)
+
+    return embed
+
+
+def test_jax_dual_swap_refusal_then_verified_pair_bit_identical(
+        pair_exports, tmp_path):
+    """The PR 10/13 refusal contract under the new lifecycle: a bank-
+    less reload under a versioned bank still 409s (now naming the
+    builder and the serving bank step) — and the path the refusal
+    points at WORKS: a tools/bank_build.py pair for the new checkpoint
+    swaps, with served embeddings bit-identical to a cold start."""
+    from moco_tpu.serve import EmbedService, ReloadRefusedError
+
+    path_a, path_b = pair_exports
+    imgs = np.random.RandomState(5).randint(
+        0, 256, (6, J_SIZE, J_SIZE, 3)).astype(np.uint8)
+    labels = np.arange(6) % 2
+
+    engine_a = _jax_engine(path_a)
+    engine_a.warmup()
+    build_bank(str(tmp_path / "bank"), 1, imgs, labels,
+               _jax_embed_fn(engine_a), checkpoint_path=path_a,
+               image_size=J_SIZE)
+    bank1 = str(tmp_path / "bank" / "1" / "bank.npz")
+    feats, lab, meta = load_bank(bank1)
+    service = EmbedService(engine_a, flush_ms=2.0, max_queue=32,
+                           request_deadline_ms=10_000.0,
+                           knn_bank=feats, knn_labels=lab, knn_k=3,
+                           knn_bank_meta=meta)
+    service.set_engine_factory(_jax_engine)
+    try:
+        with pytest.raises(ReloadRefusedError,
+                           match="tools/bank_build.py") as e:
+            service.reload(path_b)
+        assert e.value.bank_step == 1
+        assert service.reloads == 0
+
+        cold_b = _jax_engine(path_b)
+        cold_b.warmup()
+        build_bank(str(tmp_path / "bank"), 2, imgs, labels,
+                   _jax_embed_fn(cold_b), checkpoint_path=path_b,
+                   image_size=J_SIZE)
+        bank2 = str(tmp_path / "bank" / "2" / "bank.npz")
+        entry = service.reload(path_b, step=2, bank=bank2, bank_step=2)
+        assert entry["bank_step"] == 2
+        # same deterministic engine construction on both sides of the
+        # build/verify boundary: agreement is exactly 1.0
+        assert entry["bank_agreement"] == pytest.approx(1.0)
+
+        img = imgs[0]
+        row, cached = service.embed(img)
+        assert cached is False  # cache cleared at the swap
+        assert np.array_equal(row, cold_b.embed(img[None])[0])
+        cls_id, _, _ = service.classify(imgs[1])
+        assert cls_id in (0, 1)
+        assert service.bank_info()["bank_step"] == 2
+
+        # bank1 was built against checkpoint A: offering it for another
+        # reload of B is refused by the hash binding, factory never runs
+        from moco_tpu.serve import BankMismatchError
+
+        service.set_engine_factory(
+            lambda path: (_ for _ in ()).throw(AssertionError("factory")))
+        with pytest.raises(BankMismatchError, match="not a pair"):
+            service.reload(path_b, bank=bank1, bank_step=1)
+    finally:
+        service.drain(timeout_s=10.0)
+
+
+# ---------------------------------------------------------------------------
+# the full promotion soak: real serve.py replicas + --bank-dir
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_bank_promotion_soak_real_replicas(pair_exports, tmp_path):
+    """ISSUE 16 acceptance, full stack: 2 REAL tools/serve.py replicas
+    booted on the (checkpoint A, bank A) pair under a --bank-dir fleet.
+    A manifested checkpoint B WAITS until its paired bank lands, then
+    the fleet dual-swaps both replicas under closed-loop load with zero
+    lost; post-swap /v1/embed is bit-identical to a cold start on B and
+    /v1/knn answers from the new bank."""
+    import subprocess
+    import sys as _sys
+
+    path_a, path_b = pair_exports
+    serve_bench = _load_tool("serve_bench")
+    watch = tmp_path / "export"
+    watch.mkdir()
+    bank_dir = tmp_path / "bank"
+    bank_dir.mkdir()
+    serve_py = os.path.join(REPO, "tools", "serve.py")
+    bank_build_py = os.path.join(REPO, "tools", "bank_build.py")
+
+    imgs = np.random.RandomState(6).randint(
+        0, 256, (6, J_SIZE, J_SIZE, 3)).astype(np.uint8)
+    corpus = tmp_path / "corpus.npz"
+    np.savez(corpus, images=imgs, labels=np.arange(6) % 2)
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", MOCO_TPU_NO_CACHE="1")
+
+    def cli_build(checkpoint, step):
+        subprocess.run(
+            [_sys.executable, bank_build_py, "--checkpoint", checkpoint,
+             "--step", str(step), "--bank-dir", str(bank_dir),
+             "--corpus", str(corpus), "--arch", "resnet_tiny",
+             "--cifar-stem", "--image-size", str(J_SIZE),
+             "--buckets", "1,4", "--shards", "2"],
+            env=env, check=True, timeout=300,
+        )
+
+    cli_build(path_a, 1)
+    boot_bank = str(bank_dir / "1" / "bank.npz")
+
+    def child_argv(index, port, tdir, pretrained, bank=None):
+        return [_sys.executable, "-u", serve_py,
+                "--pretrained", pretrained or path_a,
+                "--knn-bank", bank or boot_bank,
+                "--arch", "resnet_tiny", "--image-size", str(J_SIZE),
+                "--cifar-stem", "true", "--buckets", "1", "4",
+                "--flush-ms", "5.0", "--port", str(port),
+                "--telemetry-dir", tdir, "--snapshot-every", "5"]
+
+    fleet = FleetSupervisor(
+        child_argv, replicas=2, telemetry_dir=str(tmp_path / "fleet_t"),
+        watch_dir=str(watch), bank_dir=str(bank_dir), env=env,
+        policy=FleetPolicy(
+            probe_secs=0.2, probe_timeout_s=2.0, health_stale_secs=10.0,
+            startup_grace_secs=240.0, term_grace_secs=5.0,
+            backoff_base_secs=0.2, backoff_max_secs=1.0,
+            watch_poll_secs=0.2, reload_timeout_s=240.0,
+        ), seed=0,
+    )
+    fleet.start()
+    try:
+        _wait(lambda: fleet.healthy_count() == 2, timeout_s=240.0,
+              msg="2 real replicas healthy")
+        # checkpoint B lands WITHOUT its bank: the fleet waits
+        step_dir = watch / "60"
+        step_dir.mkdir()
+        shutil.copy(path_b, step_dir / "encoder.npz")
+        write_manifest(str(watch), 60)
+        _wait(lambda: any(e["event"] == "bank_waiting"
+                          for e in fleet.incidents), timeout_s=60.0,
+              msg="fleet announced the missing paired bank")
+        assert all(r.deployed_step == -1 for r in fleet.replicas)
+
+        # the paired bank lands -> dual swap under closed-loop load
+        cli_build(str(step_dir / "encoder.npz"), 60)
+        result = {}
+
+        def load():
+            result.update(serve_bench.run_load(
+                fleet.router.url, concurrency=8, total_requests=128,
+                image_size=J_SIZE, pool=8, timeout_s=60.0,
+            ))
+
+        loader = threading.Thread(target=load)
+        loader.start()
+        _wait(lambda: all(r.deployed_step == 60 for r in fleet.replicas),
+              timeout_s=240.0, msg="dual swap rolled across the fleet")
+        loader.join(timeout=120.0)
+        assert result["lost"] == 0, result["lost_detail"]
+
+        # bit-identity + the new bank answers /v1/knn
+        img = imgs[0]
+        body = {"image_b64": base64.b64encode(img.tobytes()).decode(),
+                "shape": list(img.shape)}
+        status, resp = _post(fleet.router.url + "/v1/embed", body,
+                             timeout=60.0)
+        assert status == 200
+        cold = _jax_engine(path_b)
+        cold.warmup()
+        assert np.array_equal(
+            np.asarray(resp["embedding"], np.float32),
+            cold.embed(img[None])[0],
+        )
+        status, resp = _post(fleet.router.url + "/v1/knn", body,
+                             timeout=60.0)
+        assert status == 200 and resp["class"] in (0, 1)
+        assert fleet.stats()["bank"]["good_step"] == 60
+        events = [e["event"] for e in fleet.incidents]
+        assert "reload_done" in events
+    finally:
+        fleet.stop()

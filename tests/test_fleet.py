@@ -853,8 +853,13 @@ def test_reload_refused_on_ladder_change_and_knn_bank(two_exports):
                            knn_k=3)
     service.set_engine_factory(_engine_from)
     try:
-        with pytest.raises(ValueError, match="kNN bank"):
+        # since ISSUE 16 the refusal is "never WITHOUT a verified paired
+        # bank" and tells the operator what to build (the dual-swap path
+        # itself is pinned in test_bank_lifecycle.py)
+        with pytest.raises(ValueError, match="kNN bank") as e:
             service.reload(path_b)
+        assert "tools/bank_build.py" in str(e.value)
+        assert e.value.bank_step is None  # plain npz bank: no version
         # old weights (and the matching bank) still serve
         cls_id, _, _ = service.classify(_imgs(1, seed=2)[0])
         assert cls_id in (0, 1)
@@ -946,8 +951,11 @@ def test_reload_refusals_are_cheap_factory_never_called(two_exports):
 
     service.set_engine_factory(exploding_factory)
     try:
-        with pytest.raises(ValueError, match="kNN bank"):
+        with pytest.raises(ValueError, match="kNN bank") as e:
             service.reload(path_b)
+        # the 409 body's bank_step comes from the serving bank's
+        # manifest; a plain npz bank has none
+        assert e.value.bank_step is None
     finally:
         service.drain(timeout_s=5.0)
 

@@ -674,3 +674,91 @@ def test_repo_gate_warm_cache(tmp_path):
     assert warm.returncode == 0, warm.stdout + warm.stderr
     assert "cached" in warm.stdout
     assert elapsed < 10.0, f"warm gate took {elapsed:.1f}s"
+
+
+# -- R13: bank artifact writes are atomic (ISSUE 16) -------------------------
+
+
+def test_r13_flags_in_place_artifact_writes(tmp_path):
+    """A bare np.savez / json.dump / open-for-write inside the bank
+    builder reintroduces the torn-artifact window the atomic helpers
+    close — a crash mid-write leaves a promotable-looking file."""
+    body = """\
+import json
+import numpy as np
+
+
+def merge(path, feats, manifest):
+    np.savez(path, features=feats)              # in place: flagged
+    with open(path + ".json", "w") as f:        # in place: flagged
+        json.dump(manifest, f)                  # in place: flagged
+"""
+    findings = run_on(tmp_path, "moco_tpu/serve/bankbuild.py", body,
+                      select=("R13",))
+    assert rules_of(findings) == ["R13", "R13", "R13"]
+    assert "temp+rename" in findings[0].message
+
+
+def test_r13_atomic_helpers_and_reads_are_exempt(tmp_path):
+    """The atomic_* helpers ARE the temp+rename machinery (their inner
+    writes are the point); reads, default-mode opens, and undotted
+    calls never trip the rule."""
+    body = """\
+import json
+import os
+
+import numpy as np
+
+
+def atomic_write_json(path, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:                   # inside the helper: fine
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _atomic_save(path, arrays):
+    np.savez(path + ".tmp", **arrays)           # inside the helper: fine
+    os.replace(path + ".tmp", path)
+
+
+def load(path):
+    with open(path) as f:                       # a read: fine
+        return json.load(f)
+
+
+def dump(x):
+    return x
+
+
+def passthrough(x):
+    return dump(x)                              # undotted call: fine
+"""
+    assert run_on(tmp_path, "moco_tpu/serve/bankbuild.py", body,
+                  select=("R13",)) == []
+
+
+def test_r13_scope_is_the_bank_builder_only(tmp_path):
+    """R13 guards the bank artifacts, not every npz in the repo — a
+    checkpoint writer outside the builder scope stays unflagged."""
+    body = """\
+import numpy as np
+
+
+def save(path, arrays):
+    np.savez(path, **arrays)
+"""
+    assert run_on(tmp_path, "moco_tpu/checkpoint.py", body,
+                  select=("R13",)) == []
+
+
+def test_bank_build_cli_is_train_free_boundary(tmp_path):
+    """The R6 boundary pins tools/bank_build.py out of the train stack:
+    a bank builder that imports the training loop would drag jax + the
+    optimizer into the (lint-enforced jax-free) batch lane."""
+    body = """\
+from moco_tpu.train import train_loop
+"""
+    findings = run_on(tmp_path, "tools/bank_build.py", body,
+                      select=("R6",))
+    assert "R6" in rules_of(findings)

@@ -166,6 +166,10 @@ BIT_IDENTITY_MODULES = (
     "moco_tpu/serve/cache.py",
     "moco_tpu/serve/batcher.py",
     "moco_tpu/serve/service.py",
+    # ISSUE 16: the bank builder's shard→merge output is test-pinned
+    # bit-identical for any shard count — a global-RNG draw or wall-clock
+    # value in the build path would break the 1-vs-3-shard byte equality
+    "moco_tpu/serve/bankbuild.py",
     "moco_tpu/ops/",
     "moco_tpu/parallel/",
 )
@@ -187,9 +191,13 @@ STEP_BUILDER_MODULES = (
 
 DEFAULT_CONFIG = LintConfig(
     enabled=("R1", "R2", "R3", "R4", "R5", "R6", "R7",
-             "R8", "R9", "R10", "R11", "R12"),
+             "R8", "R9", "R10", "R11", "R12", "R13"),
     scopes={
         **_R1_R7_SCOPES,
+        # R13 (ISSUE 16): bank artifact writes go through the atomic
+        # temp+rename helpers — torn artifacts must never look promotable
+        "R13": RuleScope(include=("moco_tpu/serve/bankbuild.py",
+                                  "tools/bank_build.py")),
         # R12 (ISSUE 8): span context-manager discipline package-wide +
         # the stdlib-only import diet of telemetry/trace.py (which the
         # rule applies only to that file)
@@ -199,10 +207,12 @@ DEFAULT_CONFIG = LintConfig(
         "R3": RuleScope(include=("moco_tpu/",),
                         exclude=("utils/logging.py", "utils/meters.py")),
         "R5": RuleScope(include=("moco_tpu/", "tools/supervise.py",
-                                 "tools/serve.py", "tools/serve_fleet.py")),
+                                 "tools/serve.py", "tools/serve_fleet.py",
+                                 "tools/bank_build.py")),
         # R6's historical scope is moco_tpu/serve/ (fleet.py rides along);
         # the fleet CLI lives in tools/ and must honor the same boundary
         "R6": RuleScope(include=("moco_tpu/serve/", "tools/serve_fleet.py",
+                                 "tools/bank_build.py",
                                  "moco_tpu/data/service/",
                                  "tools/staging_server.py",
                                  "tools/prestage.py")),
@@ -221,10 +231,23 @@ DEFAULT_CONFIG = LintConfig(
                  "processes; a train dependency here couples the whole "
                  "fleet's availability to the training stack"),
         ),
+        # ISSUE 16: the bank builder CLI re-embeds corpora for SERVING —
+        # its orchestration must stay train-free like the serve stack
+        # (the engine-import path is the only jax it may reach)
+        Boundary(
+            name="bank-build-train-free",
+            rule_id="R6",
+            scope=("tools/bank_build.py",),
+            forbid=SERVE_FORBIDDEN,
+            why=("the bank builder produces SERVING artifacts; a train "
+                 "dependency would drag the optimizer stack into every "
+                 "promotion job (and its batch-lane mode into fleets)"),
+        ),
         Boundary(
             name="serve-train-free-transitive",
             rule_id="R11",
-            scope=("moco_tpu/serve/", "tools/serve_fleet.py"),
+            scope=("moco_tpu/serve/", "tools/serve_fleet.py",
+                   "tools/bank_build.py"),
             forbid=SERVE_FORBIDDEN,
             transitive=True,
             why=("an import CHAIN from serve/ to the train stack defeats "

@@ -1,6 +1,7 @@
 """Built-in rule plugins. Importing this package registers every rule."""
 
 from tools.mocolint.rules import (  # noqa: F401
+    atomicwrite,
     boundaries,
     collectives,
     determinism,

@@ -1,0 +1,87 @@
+"""R13: bank artifact writes go through the atomic temp+rename helpers.
+
+The bank lifecycle (ISSUE 16) promises that a partially written artifact
+is NEVER eligible for promotion: shard files, the merged bank npz, and
+the manifest all land via temp-file + `os.replace`, with the manifest
+written last. A bare `np.savez(...)`, `json.dump(...)`, or
+`open(path, "w")` in the builder would reintroduce the torn-artifact
+window the whole design exists to close — a watcher or a swapping
+replica could read half a bank and promote it.
+
+Scope (config): `moco_tpu/serve/bankbuild.py` + `tools/bank_build.py`.
+Exempt: code inside the atomic helpers themselves (any function whose
+name starts with `atomic_` or `_atomic`) — they ARE the temp+rename
+machinery.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.mocolint.astutil import call_name, dotted
+from tools.mocolint.registry import Rule, register
+
+# call tails that write an artifact directly
+_BANNED_TAILS = {"savez", "savez_compressed", "save", "dump"}
+_WRITE_MODES = ("w", "a", "x")
+
+
+def _opens_for_write(node: ast.Call) -> bool:
+    if call_name(node.func) != "open":
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # default "r": a read
+    return (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+            and mode.value.startswith(_WRITE_MODES))
+
+
+@register
+class NonAtomicBankWrite(Rule):
+    id = "R13"
+    title = "bank artifact writes must use the atomic temp+rename helpers"
+    rationale = ("a torn shard/bank/manifest written in place is a "
+                 "promotable-looking artifact with wrong bytes; the "
+                 "builder's whole crash-safety story is temp + os.replace "
+                 "with the manifest last")
+
+    def check_file(self, ctx):
+        exempt_spans: list[tuple[int, int]] = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name.lstrip("_").startswith("atomic_")):
+                exempt_spans.append(
+                    (node.lineno, getattr(node, "end_lineno", node.lineno))
+                )
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            line = node.lineno
+            if any(lo <= line <= hi for lo, hi in exempt_spans):
+                continue
+            name = dotted(node.func) or call_name(node.func) or ""
+            tail = call_name(node.func)
+            if tail in _BANNED_TAILS and "." in name:
+                # np.savez / np.save / json.dump / pickle.dump — a direct
+                # in-place artifact write (os.replace et al have no
+                # banned tail, so plain calls pass untouched)
+                yield self.finding(
+                    ctx, line,
+                    f"`{name}(...)` writes an artifact in place — a "
+                    "crash mid-write leaves a torn file that looks "
+                    "promotable; route it through the atomic_* "
+                    "temp+rename helpers (manifest last)",
+                )
+            elif _opens_for_write(node):
+                yield self.finding(
+                    ctx, line,
+                    "`open(..., \"w\"/\"a\"/\"x\")` writes in place in "
+                    "the bank builder — use the atomic_* temp+rename "
+                    "helpers so a partial artifact is never eligible "
+                    "for promotion",
+                )

@@ -45,8 +45,6 @@ from moco_tpu.utils.logging import info, log_event  # noqa: E402
 
 def build_service(config: ServeConfig):
     """Engine + service from a ServeConfig (shared with bench/tests)."""
-    import numpy as np
-
     from moco_tpu.serve import EmbeddingEngine, EmbedService
 
     def engine_factory(path: str) -> "EmbeddingEngine":
@@ -82,15 +80,15 @@ def build_service(config: ServeConfig):
             os.path.join(config.telemetry_dir, EVENTS_FILENAME),
             stamp={"run_id": tracer.run_id, "trace_id": tracer.trace_id},
         )
-    knn_bank = knn_labels = None
+    knn_bank = knn_labels = knn_bank_meta = None
     if config.knn_bank:
-        bank = np.load(config.knn_bank)
-        if "features" not in bank or "labels" not in bank:
-            raise ValueError(
-                f"--knn-bank {config.knn_bank!r} needs `features` [N,D] "
-                "and `labels` [N] arrays"
-            )
-        knn_bank, knn_labels = bank["features"], bank["labels"]
+        from moco_tpu.serve.bankbuild import load_bank
+
+        # versioned banks (ISSUE 16) come back with their manifest
+        # metadata (checkpoint binding + probe rows) so the service can
+        # dual-swap (engine, bank) pairs; a plain npz gets meta=None and
+        # behaves exactly as before
+        knn_bank, knn_labels, knn_bank_meta = load_bank(config.knn_bank)
     service = EmbedService(
         engine,
         flush_ms=config.flush_ms,
@@ -108,6 +106,8 @@ def build_service(config: ServeConfig):
         knn_temperature=config.knn_temperature,
         reload_probe=config.reload_probe,
         reload_min_spread=config.reload_min_spread,
+        knn_bank_meta=knn_bank_meta,
+        bank_agreement_min=config.bank_agreement_min,
     )
     service.set_engine_factory(engine_factory)
     return service, registry

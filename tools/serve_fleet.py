@@ -75,6 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--watch-dir", default="",
                    help="checkpoint export dir to watch for hot reloads "
                         "(PR 1 step layout + integrity manifests)")
+    p.add_argument("--bank-dir", default="",
+                   help="versioned kNN-bank dir (tools/bank_build.py "
+                        "layout): a watched step deploys ONLY with its "
+                        "verifying paired bank, rolled as an atomic "
+                        "(engine, bank) dual swap (ISSUE 16)")
     p.add_argument("--probe-secs", type=float, default=1.0)
     p.add_argument("--probe-timeout-s", type=float, default=2.0)
     p.add_argument("--health-stale-secs", type=float, default=10.0,
@@ -125,13 +130,19 @@ def main(argv=None) -> int:
         return EXIT_CONFIG_ERROR
 
     def child_argv(index: int, port: int, telemetry_dir: str,
-                   pretrained: str | None) -> list:
+                   pretrained: str | None,
+                   bank: str | None = None) -> list:
         out = list(cmd) + ["--port", str(port),
                            "--telemetry-dir", telemetry_dir]
         if pretrained:
             # argparse last-wins: this overrides the base command's
             # --pretrained so a relaunch boots on the deployed weights
             out += ["--pretrained", pretrained]
+        if bank:
+            # dual-swap fleets (ISSUE 16): pin the deployed bank too —
+            # a relaunch must boot on the (weights, bank) PAIR, never
+            # new weights over the boot-time bank
+            out += ["--knn-bank", bank]
         return out
 
     replica_env = {}
@@ -168,6 +179,7 @@ def main(argv=None) -> int:
         base_port=args.base_port,
         policy=policy,
         watch_dir=args.watch_dir,
+        bank_dir=args.bank_dir,
         replica_env=replica_env,
     )
     try:

@@ -39,6 +39,12 @@ Renders, from the schema-versioned record stream the driver writes
     (detected / rolled / quarantined), and a per-replica fold of each
     replica's own last serve snapshot (the single-file `serve:` section
     assumes exactly one server)
+  - bank lifecycle (ISSUE 16): the `kind: "bank"` records the bank
+    builder (build_start/shard_done/build_done), the embedding service
+    (the atomic dual `swap`), and the fleet (bank_waiting / quarantine /
+    bank_quarantine / rollback) emit, folded as a `bank:` section
+    (builds, swaps, quarantines, rollbacks, last build/swap, bank age) —
+    and rendered live by --follow, like fleet lines
   - SLO transitions (ISSUE 12): the `kind: "slo"` alert/recovery records
     tools/obsd.py appends into the same stream, folded per rule
     (alert/recovery counts, still-active rules) as a `slo:` section —
@@ -157,6 +163,7 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
     fleet = [r for r in records if r.get("kind") == "fleet"]
     slos = [r for r in records if r.get("kind") == "slo"]
     input_servers = [r for r in records if r.get("kind") == "input_server"]
+    banks = [r for r in records if r.get("kind") == "bank"]
 
     step_s = [r["step_s"] for r in steps if "step_s" in r]
     data_s = [r["data_s"] for r in steps if "data_s" in r]
@@ -326,6 +333,8 @@ def summarize(records: list[dict], skipped: int = 0) -> dict:
         summary["fleet"] = _summarize_fleet(fleet, serves)
     if input_servers:
         summary["input_servers"] = _summarize_input_servers(input_servers)
+    if banks:
+        summary["bank"] = _summarize_bank(banks)
     health_sec = _summarize_health(steps, events)
     if health_sec:
         summary["health"] = health_sec
@@ -407,6 +416,53 @@ def _summarize_input_servers(records: list[dict]) -> dict:
         servers[str(sid)] = entry
     return {"servers": servers, "totals": totals,
             "n_servers": len(servers)}
+
+
+def _summarize_bank(banks: list[dict]) -> dict:
+    """Fold the `kind:"bank"` lifecycle stream (ISSUE 16): builder
+    progress (build_start/shard_done/build_done), each replica's atomic
+    dual `swap`, and the fleet's `bank_waiting`/`quarantine`/
+    `bank_quarantine`/`rollback`. Event names normalize to the same
+    `bank_` prefix obsd uses at ingest, so the section's counters match
+    `event:bank_*` SLO objectives line for line."""
+    by_event: dict[str, int] = {}
+    last_swap = None
+    last_build = None
+    for r in banks:
+        name = str(r.get("event", "unknown"))
+        if not name.startswith("bank"):
+            name = "bank_" + name
+        by_event[name] = by_event.get(name, 0) + 1
+        if name == "bank_swap":
+            last_swap = r
+        elif name == "bank_build_done":
+            last_build = r
+    sec: dict = {
+        "events": dict(sorted(by_event.items())),
+        "builds": by_event.get("bank_build_done", 0),
+        "swaps": by_event.get("bank_swap", 0),
+        "quarantines": by_event.get("bank_quarantine", 0),
+        "rollbacks": by_event.get("bank_rollback", 0),
+    }
+    if last_build is not None:
+        sec["last_build"] = {
+            k: last_build[k]
+            for k in ("step", "rows", "feat_dim", "shards",
+                      "manifest_sha256")
+            if k in last_build
+        }
+    if last_swap is not None:
+        sec["last_swap"] = {
+            k: last_swap[k]
+            for k in ("step", "bank_step", "rows", "generation",
+                      "agreement")
+            if k in last_swap
+        }
+        step, bank_step = last_swap.get("step"), last_swap.get("bank_step")
+        if (isinstance(step, (int, float))
+                and isinstance(bank_step, (int, float))):
+            sec["age_steps"] = int(step - bank_step)
+    return sec
 
 
 def _summarize_health(steps: list[dict], events: list[dict]) -> dict | None:
@@ -938,6 +994,32 @@ def render(summary: dict) -> str:
                    f"({', '.join(str(h.get('step')) for h in quarantined[-6:])})"
                    if quarantined else "")
             )
+    bank = summary.get("bank")
+    if bank:
+        lines.append(
+            f"bank: {bank.get('builds', 0)} build(s) · "
+            f"{bank.get('swaps', 0)} dual swap(s) · "
+            f"{bank.get('quarantines', 0)} quarantine(s) · "
+            f"{bank.get('rollbacks', 0)} rollback(s)"
+        )
+        lb = bank.get("last_build")
+        if lb:
+            lines.append(
+                f"  last build: step {lb.get('step', '?')} — "
+                f"{lb.get('rows', '?')} rows × {lb.get('feat_dim', '?')} "
+                f"dims in {lb.get('shards', '?')} shard(s)"
+            )
+        ls = bank.get("last_swap")
+        if ls:
+            agree = ls.get("agreement")
+            lines.append(
+                f"  last swap: checkpoint step {ls.get('step', '?')} + "
+                f"bank step {ls.get('bank_step', '?')} "
+                f"(generation {ls.get('generation', '?')}"
+                + (f", probe agreement {agree:.4f}"
+                   if isinstance(agree, (int, float)) else "")
+                + f") — bank age {bank.get('age_steps', '?')} step(s)"
+            )
     health = summary.get("health")
     if health:
         last = health.get("last", {})
@@ -1095,6 +1177,14 @@ def render_record(rec: dict) -> str | None:
             if k not in ("v", "t", "kind", "event", "run_id", "trace_id")
         )
         return f"fleet: {rec.get('event', '?')} {detail}".rstrip()
+    if kind == "bank":
+        # bank lifecycle (ISSUE 16): a build/swap/quarantine/rollback in
+        # progress gets the fleet-style detail line
+        detail = " ".join(
+            f"{k}={v}" for k, v in rec.items()
+            if k not in ("v", "t", "kind", "event", "run_id", "trace_id")
+        )
+        return f"bank: {rec.get('event', '?')} {detail}".rstrip()
     if kind == "input_server":
         # staging-server stream (ISSUE 14): stats snapshots get a compact
         # throughput line, lifecycle transitions the fleet-style detail
