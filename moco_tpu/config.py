@@ -620,6 +620,21 @@ class ServeConfig:
     # the same rows must clear this floor or the pair is refused
     # (409 reload_bank_mismatch — the fleet quarantines the pair)
     bank_agreement_min: float = 0.98
+    # sharded ANN index (ISSUE 20): ann_cells > 0 requires a verified
+    # paired index next to the bank (tools/bank_build.py --ann-cells)
+    # and replaces the exact /v1/knn vote with the IVF probe; 0 keeps
+    # the exact path bit-identical to before
+    ann_cells: int = 0                # coarse-quantizer cells (0 = exact)
+    ann_nprobe: int = 8               # cells probed per query
+    ann_rerank: int = 0               # candidates kept per probe
+                                      # (0 = knn_k)
+    ann_shard: int = 0                # this replica's cell partition ...
+    ann_shards: int = 1               # ... of how many (cell % shards)
+    # tiered admission (ISSUE 20): interactive vs batch lanes
+    admission_tiers: bool = True      # False folds "batch" onto the
+                                      # interactive lane
+    batch_max_queue: int = 1024       # batch-lane admission depth
+    batch_deadline_ms: float = 30000.0  # batch-lane default deadline
 
     def __post_init__(self):
         # the ONE bucket-ladder rule, shared with the runtime's own check
@@ -661,6 +676,32 @@ class ServeConfig:
             raise ValueError(
                 "trace_capture_steps must be >= 1, trace_capture_budget "
                 "and trace_shed_spike >= 0"
+            )
+        if self.ann_cells < 0 or self.ann_nprobe < 1 or self.ann_rerank < 0:
+            raise ValueError(
+                "need ann_cells >= 0 (0 = exact), ann_nprobe >= 1, "
+                f"ann_rerank >= 0 (0 = knn_k); got {self.ann_cells} / "
+                f"{self.ann_nprobe} / {self.ann_rerank}"
+            )
+        if self.ann_shards < 1 or not 0 <= self.ann_shard < self.ann_shards:
+            raise ValueError(
+                f"need 0 <= ann_shard < ann_shards, got "
+                f"{self.ann_shard} / {self.ann_shards}"
+            )
+        if self.ann_cells and not self.knn_bank:
+            raise ValueError(
+                "ann_cells > 0 needs a --knn-bank (the index pairs with "
+                "a versioned bank)"
+            )
+        if self.batch_max_queue < b[-1]:
+            raise ValueError(
+                f"batch_max_queue ({self.batch_max_queue}) must hold at "
+                f"least one full bucket ({b[-1]})"
+            )
+        if self.batch_deadline_ms <= 0:
+            raise ValueError(
+                f"batch_deadline_ms must be > 0, got "
+                f"{self.batch_deadline_ms}"
             )
 
     def replace(self, **kw) -> "ServeConfig":

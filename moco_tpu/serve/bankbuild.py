@@ -464,6 +464,10 @@ def http_embed_fn(base_url: str, *, timeout_s: float = 30.0):
         for img in np.asarray(batch):
             body = json.dumps({
                 "pixels": img.astype(np.uint8).tolist(),
+                # tiered admission (ISSUE 20): a fleet-mode bank build
+                # is throughput work — it rides the batch lane so a
+                # build flood can never shed interactive traffic
+                "tier": "batch",
             }).encode()
             req = urllib.request.Request(
                 url, data=body,

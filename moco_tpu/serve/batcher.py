@@ -38,6 +38,14 @@ import numpy as np
 
 from moco_tpu.telemetry.trace import SpikeDetector, null_tracer
 
+# Admission tiers (ISSUE 20): interactive user traffic and bulk batch
+# work (bank_build re-embeds) ride SEPARATE bounded queues with separate
+# deadlines, so a batch flood can fill its own lane to the brim without
+# ever costing an interactive request its admission slot. The flusher
+# serves interactive strictly first and backfills spare bucket capacity
+# with batch rows — priority, not partitioned throughput.
+TIERS = ("interactive", "batch")
+
 
 class RejectionError(Exception):
     """A request that got a structured DECISION instead of a result.
@@ -102,11 +110,13 @@ class PendingRequest:
     merge on wall-clock."""
 
     __slots__ = ("payload", "enqueue_t", "enqueue_wall", "deadline_t",
-                 "result", "error", "_done")
+                 "tier", "result", "error", "_done")
 
-    def __init__(self, payload, enqueue_t: float, deadline_t: float):
+    def __init__(self, payload, enqueue_t: float, deadline_t: float,
+                 tier: str = "interactive"):
         self.payload = payload
         self.enqueue_t = enqueue_t
+        self.tier = tier
         # wall-clock by design: retroactive request spans must merge
         # with other processes' timelines on a shared clock; the value
         # never feeds computation  # mocolint: disable=R9
@@ -158,6 +168,8 @@ class MicroBatcher:
         name: str = "embed",
         tracer=None,
         shed_spike_min: int = 8,
+        batch_max_queue: int | None = None,
+        batch_deadline_ms: float | None = None,
     ):
         self.buckets = validate_buckets(buckets)
         if max_queue < self.buckets[-1]:
@@ -170,6 +182,20 @@ class MicroBatcher:
         self._flush_s = float(flush_ms) / 1e3
         self.max_queue = int(max_queue)
         self._default_deadline_s = float(default_deadline_ms) / 1e3
+        # batch lane defaults: same depth as interactive, a LONGER
+        # deadline (bulk work tolerates queueing; it must not be shed by
+        # a deadline tuned for user latency)
+        self.max_queue_by_tier = {
+            "interactive": int(max_queue),
+            "batch": int(batch_max_queue if batch_max_queue is not None
+                         else max_queue),
+        }
+        self._deadline_s_by_tier = {
+            "interactive": self._default_deadline_s,
+            "batch": (float(batch_deadline_ms) / 1e3
+                      if batch_deadline_ms is not None
+                      else self._default_deadline_s),
+        }
         self._on_batch = on_batch
         # tracing (ISSUE 8): flush/engine spans + retroactive per-request
         # spans, and the shed-spike detector arming a budgeted capture
@@ -177,12 +203,16 @@ class MicroBatcher:
         self._tracer = tracer if tracer is not None else null_tracer()
         self._shed_spike = SpikeDetector(min_events=shed_spike_min)
         self._flush_seq = 0
-        self._queue: deque[PendingRequest] = deque()
+        self._queues: dict[str, deque[PendingRequest]] = {
+            t: deque() for t in TIERS
+        }
         self._cond = threading.Condition()
         self._draining = False
         self._closed = False
         self._inflight = 0
-        # counters (read under the cond lock by stats consumers)
+        # counters (read under the cond lock by stats consumers).
+        # shed_overload/shed_deadline stay TOTALS across tiers (the
+        # pre-tier stats contract); *_by_tier carry the breakdown.
         self.submitted = 0
         self.completed = 0
         self.shed_overload = 0
@@ -190,30 +220,40 @@ class MicroBatcher:
         self.batch_errors = 0
         self.batches = 0
         self.occupancy_sum = 0.0
+        self.submitted_by_tier = {t: 0 for t in TIERS}
+        self.shed_overload_by_tier = {t: 0 for t in TIERS}
+        self.shed_deadline_by_tier = {t: 0 for t in TIERS}
         self._thread = threading.Thread(
             target=self._flush_loop, daemon=True, name=f"{name}-flusher"
         )
         self._thread.start()
 
     # -- admission -----------------------------------------------------------
-    def submit(self, payload, deadline_s: float | None = None) -> PendingRequest:
+    def submit(self, payload, deadline_s: float | None = None,
+               tier: str = "interactive") -> PendingRequest:
         """Admit one request or raise a structured rejection IMMEDIATELY
         (bounded queue: the overloaded answer must be cheap and instant,
-        never a timeout the client discovers on their own)."""
+        never a timeout the client discovers on their own). Admission is
+        PER TIER: a full batch lane sheds batch work only."""
+        if tier not in TIERS:
+            raise ValueError(f"unknown tier {tier!r} (one of {TIERS})")
         now = time.monotonic()
         if deadline_s is None:
-            deadline_s = self._default_deadline_s
-        pending = PendingRequest(payload, now, now + deadline_s)
+            deadline_s = self._deadline_s_by_tier[tier]
+        pending = PendingRequest(payload, now, now + deadline_s, tier)
         queue_len = -1
         with self._cond:
             if self._draining or self._closed:
                 raise DrainingError("service is draining; not accepting work")
-            if len(self._queue) >= self.max_queue:
+            q = self._queues[tier]
+            if len(q) >= self.max_queue_by_tier[tier]:
                 self.shed_overload += 1
-                queue_len = len(self._queue)
+                self.shed_overload_by_tier[tier] += 1
+                queue_len = len(q)
             else:
                 self.submitted += 1
-                self._queue.append(pending)
+                self.submitted_by_tier[tier] += 1
+                q.append(pending)
                 self._cond.notify_all()
         if queue_len >= 0:
             # tracer work OUTSIDE the admission lock: a span-ring flush is
@@ -225,20 +265,31 @@ class MicroBatcher:
                 # profile: arm the capture window, budget-bounded
                 self._tracer.maybe_autocapture("shed_spike")
             self._tracer.instant("shed_overload", cat="serve",
-                                 queue=queue_len)
+                                 queue=queue_len, tier=tier)
             # crude but honest hint: full queues ahead of this request
             # each take at least one flush window to clear
             depth_batches = 1 + queue_len // self.buckets[-1]
             raise OverloadedError(
-                f"admission queue full ({self.max_queue})",
+                f"admission queue full "
+                f"({self.max_queue_by_tier[tier]}, tier={tier})",
                 retry_after_ms=round(depth_batches * self._flush_s * 1e3, 1),
+                tier=tier,
             )
         return pending
+
+    def _qlen(self) -> int:
+        # caller holds self._cond
+        return sum(len(q) for q in self._queues.values())
 
     @property
     def queue_depth(self) -> int:
         with self._cond:
-            return len(self._queue) + self._inflight
+            return self._qlen() + self._inflight
+
+    @property
+    def queue_depth_by_tier(self) -> dict:
+        with self._cond:
+            return {t: len(q) for t, q in self._queues.items()}
 
     @property
     def occupancy_mean(self) -> float:
@@ -249,22 +300,29 @@ class MicroBatcher:
     def _flush_loop(self) -> None:
         while True:
             with self._cond:
-                while not self._queue and not self._closed:
+                while not self._qlen() and not self._closed:
                     self._cond.wait()
-                if not self._queue:  # closed and empty: done
+                if not self._qlen():  # closed and empty: done
                     return
                 # coalesce window: more work may arrive until the oldest
                 # request's flush deadline OR a full largest bucket,
                 # whichever first; draining flushes immediately
-                flush_at = self._queue[0].enqueue_t + self._flush_s
-                while (len(self._queue) < self.buckets[-1]
+                flush_at = min(
+                    q[0].enqueue_t for q in self._queues.values() if q
+                ) + self._flush_s
+                while (self._qlen() < self.buckets[-1]
                        and not self._draining and not self._closed):
                     remaining = flush_at - time.monotonic()
                     if remaining <= 0:
                         break
                     self._cond.wait(timeout=remaining)
-                take = min(len(self._queue), self.buckets[-1])
-                batch = [self._queue.popleft() for _ in range(take)]
+                # interactive first, batch backfills spare bucket slots
+                take = min(self._qlen(), self.buckets[-1])
+                batch = []
+                for tier in TIERS:
+                    q = self._queues[tier]
+                    while q and len(batch) < take:
+                        batch.append(q.popleft())
                 self._inflight = len(batch)
             try:
                 self._execute(batch)
@@ -288,6 +346,8 @@ class MicroBatcher:
             self._request_span(p, now, "deadline_exceeded", seq)
         with self._cond:
             self.shed_deadline += len(expired)
+            for p in expired:
+                self.shed_deadline_by_tier[p.tier] += 1
         if not live:
             return
         bucket = bucket_for(len(live), self.buckets)
@@ -343,7 +403,7 @@ class MicroBatcher:
         with self._cond:
             self._draining = True
             self._cond.notify_all()
-            while self._queue or self._inflight:
+            while self._qlen() or self._inflight:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
@@ -357,8 +417,9 @@ class MicroBatcher:
         with self._cond:
             self._draining = True
             self._closed = True
-            leftovers = list(self._queue)
-            self._queue.clear()
+            leftovers = [p for q in self._queues.values() for p in q]
+            for q in self._queues.values():
+                q.clear()
             self._cond.notify_all()
         for p in leftovers:
             p.resolve(error=DrainingError("batcher closed before execution"))

@@ -64,6 +64,7 @@ from __future__ import annotations
 import dataclasses
 import http.client
 import json
+import math
 import os
 import random
 import socket
@@ -147,6 +148,23 @@ class FleetPolicy:
                                        # _emit_router_stats for the schema)
     stats_latency_window: int = 512    # router-latency ring size behind
                                        # the router_stats p50/p95/p99
+    # telemetry-driven autoscaling (ISSUE 20): the controller consumes
+    # the SAME windowed router_stats stream obsd reads — counter deltas
+    # between consecutive emits — with hysteresis (consecutive breached/
+    # idle windows) and a cooldown so one noisy window never flaps the
+    # fleet. autoscale_max=0 (default) disables the whole subsystem.
+    autoscale_min: int = 1             # never reap below this many
+                                       # (shard cover raises the floor)
+    autoscale_max: int = 0             # replica budget; 0 = autoscaler off
+    autoscale_cooldown_s: float = 60.0 # min gap between scale actions
+    autoscale_up_after: int = 2        # consecutive breached windows
+    autoscale_down_after: int = 6      # consecutive idle windows
+    autoscale_shed_high: float = 0.02  # shed-rate breach threshold
+    autoscale_outstanding_high: float = 4.0  # in-flight per healthy
+                                       # replica breach threshold
+    autoscale_p99_high_ms: float = 0.0 # p99 breach threshold; 0 = off
+    autoscale_idle_low: float = 0.25   # outstanding/healthy below this
+                                       # (and zero sheds) counts as idle
 
     def backoff_secs(self, consecutive_failures: int,
                      rng: random.Random) -> float:
@@ -178,6 +196,12 @@ class ReplicaState:
         self.abandoned = False         # fatal class or exhausted budget
         self.expected_exit = False     # WE asked it to exit (roll, stop)
         self.outstanding = 0           # router's in-flight count
+        self.shard: int | None = None  # owned ANN cell partition (ISSUE
+                                       # 20); None on ann-free fleets
+        self.reaping = False           # autoscale drain-then-reap in
+                                       # progress: never relaunched,
+                                       # removed from the table once the
+                                       # process is gone
         self.launched_at = 0.0
         self.last_ok_life: float | None = None  # newest probe ANSWER (200
                                        # or draining-503) this life
@@ -209,6 +233,7 @@ class ReplicaState:
             "draining": self.draining,
             "abandoned": self.abandoned,
             "outstanding": self.outstanding,
+            "shard": self.shard,
             "launches": self.launches,
             "restarts": max(self.launches - 1, 0),
             "budget_left": self.budget,
@@ -437,6 +462,89 @@ class CheckpointWatcher:
 
 
 # ---------------------------------------------------------------------------
+# telemetry-driven autoscaling (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+class AutoscaleController:
+    """Pure decision logic for telemetry-driven autoscaling.
+
+    Feed it consecutive router_stats-shaped snapshots (cumulative
+    counters + gauges) on the stats cadence; it answers ("up"|"down",
+    reason) or None. Deliberately free of threads, wall clocks, and
+    fleet state so the hysteresis is unit-testable with plain dicts:
+
+      - breach: windowed shed RATE (Δsheds/Δrequests) above
+        `autoscale_shed_high`, in-flight depth per healthy replica
+        above `autoscale_outstanding_high`, or — when enabled — p99
+        above `autoscale_p99_high_ms`;
+      - idle: ZERO sheds this window AND depth per healthy replica
+        below `autoscale_idle_low`;
+      - hysteresis: `autoscale_up_after` consecutive breached windows
+        scale up, `autoscale_down_after` consecutive idle ones scale
+        down; a mixed window resets both streaks — one noisy sample
+        never moves capacity;
+      - cooldown: actions at least `autoscale_cooldown_s` apart.
+        Streaks KEEP accumulating through a cooldown, so a sustained
+        breach fires the moment the window reopens.
+    """
+
+    SHED_KEYS = ("shed_no_backend", "upstream_timeout", "upstream_error",
+                 "shed_deadline_router")
+
+    def __init__(self, policy: FleetPolicy):
+        self.policy = policy
+        self._prev: dict | None = None
+        self.breach_streak = 0
+        self.idle_streak = 0
+        self.last_action_at = float("-inf")
+
+    def observe(self, stats: dict, now: float) -> tuple[str, str] | None:
+        p = self.policy
+        prev, self._prev = self._prev, dict(stats)
+        if prev is None:
+            return None  # first window: no deltas yet
+        d_req = stats.get("requests", 0) - prev.get("requests", 0)
+        d_shed = sum(stats.get(k, 0) - prev.get(k, 0)
+                     for k in self.SHED_KEYS)
+        shed_rate = d_shed / max(d_req, 1)
+        healthy = max(int(stats.get("healthy") or 0), 1)
+        depth = float(stats.get("outstanding") or 0) / healthy
+        p99 = float((stats.get("latency_ms") or {}).get("p99") or 0.0)
+        breach = None
+        if shed_rate > p.autoscale_shed_high:
+            breach = (f"shed_rate {shed_rate:.4f} > "
+                      f"{p.autoscale_shed_high} over the window")
+        elif depth > p.autoscale_outstanding_high:
+            breach = (f"outstanding/healthy {depth:.2f} > "
+                      f"{p.autoscale_outstanding_high}")
+        elif p.autoscale_p99_high_ms and p99 > p.autoscale_p99_high_ms:
+            breach = f"p99 {p99:.1f}ms > {p.autoscale_p99_high_ms}ms"
+        if breach is not None:
+            self.breach_streak += 1
+            self.idle_streak = 0
+        elif d_shed == 0 and depth < p.autoscale_idle_low:
+            self.idle_streak += 1
+            self.breach_streak = 0
+        else:
+            self.breach_streak = 0
+            self.idle_streak = 0
+        if now - self.last_action_at < p.autoscale_cooldown_s:
+            return None
+        if self.breach_streak >= p.autoscale_up_after:
+            self.breach_streak = 0
+            self.last_action_at = now
+            return "up", breach
+        if self.idle_streak >= p.autoscale_down_after:
+            self.idle_streak = 0
+            self.last_action_at = now
+            return "down", (f"idle for {p.autoscale_down_after} windows "
+                            f"(outstanding/healthy {depth:.2f} < "
+                            f"{p.autoscale_idle_low}, zero sheds)")
+        return None
+
+
+# ---------------------------------------------------------------------------
 # the fleet supervisor
 # ---------------------------------------------------------------------------
 
@@ -469,16 +577,36 @@ class FleetSupervisor:
         env: dict | None = None,
         replica_env: dict | None = None,
         seed: int | None = None,
+        ann_shards: int = 0,
     ):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if ann_shards < 0:
+            raise ValueError(f"ann_shards must be >= 0, got {ann_shards}")
+        if ann_shards and replicas < ann_shards:
+            raise ValueError(
+                f"ann_shards={ann_shards} needs at least that many "
+                f"replicas to cover every cell partition, got "
+                f"{replicas}"
+            )
         self._child_argv = child_argv
         self.n_replicas = int(replicas)
+        self.ann_shards = int(ann_shards)
         self.telemetry_dir = telemetry_dir
         self.host = host
         self._router_port = router_port
         self._base_port = base_port
         self.policy = policy or FleetPolicy()
+        if self.policy.autoscale_max:
+            if self.policy.autoscale_min < 1:
+                raise ValueError("autoscale_min must be >= 1")
+            if self.policy.autoscale_max < max(self.policy.autoscale_min,
+                                               replicas):
+                raise ValueError(
+                    f"autoscale_max={self.policy.autoscale_max} below "
+                    f"max(autoscale_min={self.policy.autoscale_min}, "
+                    f"replicas={replicas})"
+                )
         self.watch_dir = watch_dir
         self._env = env
         self._replica_env = dict(replica_env or {})
@@ -545,10 +673,25 @@ class FleetSupervisor:
                                        # an attempt could even be forwarded
         self.r_passthrough_error = 0   # replica answered non-200 (its own
                                        # structured shed: counted, passed)
+        # tiered admission + sharded-kNN counters (ISSUE 20)
+        self.r_tier = {"interactive": 0, "batch": 0}
+        self.r_knn_fanout = 0          # /v1/knn requests scatter-gathered
+                                       # across ANN shards
+        self.r_knn_partial = 0         # fan-outs answered with < every
+                                       # shard (flagged partial: true)
         # answered-request latency window (lock-free GIL-atomic appends
         # from handler threads) behind router_stats' p50/p95/p99
         self._router_latency = PercentileWindow(
             self.policy.stats_latency_window)
+        # end-to-end fan-out latency (embed leg + scatter + merge) — a
+        # separate window so the merge overhead stays observable next to
+        # the single-backend p99
+        self._knn_merge = PercentileWindow(self.policy.stats_latency_window)
+        # autoscaling (ISSUE 20): replica indices keep growing past the
+        # boot count so a reaped index is never reused (telemetry dirs
+        # and event streams stay unambiguous)
+        self._next_index = self.n_replicas
+        self._autoscaler = AutoscaleController(self.policy)
 
     # -- structured events ---------------------------------------------------
     def _emit(self, event: str, **fields) -> None:
@@ -597,12 +740,18 @@ class FleetSupervisor:
                 ports.append(port)
                 rdir = os.path.join(self.telemetry_dir, f"replica{i}")
                 os.makedirs(rdir, exist_ok=True)
-                self.replicas.append(
-                    ReplicaState(i, self.host, port, rdir,
+                r = ReplicaState(i, self.host, port, rdir,
                                  self.policy.max_restarts)
-                )
+                if self.ann_shards:
+                    # round-robin cell-partition ownership: replicas
+                    # i, i+shards, ... serve shard i%shards, so every
+                    # shard keeps cover while any ⌈N/shards⌉ subset of
+                    # its owners is healthy
+                    r.shard = i % self.ann_shards
+                self.replicas.append(r)
             self._emit("fleet_start", replicas=self.n_replicas,
                        ports=ports, router=self.router.url,
+                       ann_shards=self.ann_shards or None,
                        watch_dir=self.watch_dir or None)
             for r in self.replicas:
                 self._launch(r)
@@ -704,6 +853,10 @@ class FleetSupervisor:
             "upstream_error": self.r_upstream_error,
             "shed_deadline_router": self.r_deadline_router,
             "passthrough_non_200": self.r_passthrough_error,
+            "requests_interactive": self.r_tier["interactive"],
+            "requests_batch": self.r_tier["batch"],
+            "knn_fanout": self.r_knn_fanout,
+            "knn_partial": self.r_knn_partial,
         }
 
     # -- routing (called from router handler threads) ------------------------
@@ -735,10 +888,36 @@ class FleetSupervisor:
             self._emit("eject", replica=r.index, reason=reason)
 
     def router_proxy(self, path: str, body: bytes) -> tuple[int, bytes]:
-        """One client request: pick → forward → (maybe) retry once on a
-        DIFFERENT replica → answer. Returns (status, response bytes)."""
+        """One client request: count its admission tier, then either
+        scatter-gather `/v1/knn` across the ANN shards (ISSUE 20) or
+        route it to one backend. Returns (status, response bytes)."""
         with self._lock:
             self.r_requests += 1
+            self.r_tier[self._tier_of(body)] += 1
+        if path == "/v1/knn" and self.ann_shards > 1:
+            return self._knn_fanout(body)
+        return self._routed_request(path, body)
+
+    def _tier_of(self, body: bytes) -> str:
+        """The request's admission tier for the router's per-tier
+        counters (the replica's MicroBatcher enforces the lanes; the
+        router only accounts). Same substring pre-check as _deadline_s:
+        the common untagged path never pays a JSON parse."""
+        if b'"tier"' in body:
+            try:
+                if json.loads(body).get("tier") == "batch":
+                    return "batch"
+            except (ValueError, json.JSONDecodeError):
+                pass  # malformed body: the replica answers 400 either way
+        return "interactive"
+
+    def _routed_request(self, path: str, body: bytes,
+                        leg: bool = False) -> tuple[int, bytes]:
+        """Pick → forward → (maybe) retry once on a DIFFERENT replica →
+        answer. `leg=True` (a fan-out's embed phase) suppresses the
+        success-path ok/latency accounting — the fan-out counts its own
+        end-to-end outcome — while every shed/timeout path still counts
+        and observes: those ARE the client's final answer."""
         t_start = time.monotonic()
         deadline = t_start + self._deadline_s(body)
         tried: list[int] = []
@@ -799,12 +978,17 @@ class FleetSupervisor:
                 }).encode()
             finally:
                 self.release_backend(replica)
-            self._router_latency.observe(time.monotonic() - t_start)
+            if not leg or status != 200:
+                # a leg's 200 is an intermediate hop (the fan-out
+                # observes the end-to-end total); its non-200 passes
+                # through as the client's final answer
+                self._router_latency.observe(time.monotonic() - t_start)
             with self._lock:
                 if status == 200:
-                    self.r_ok += 1
-                    if attempt:
-                        self.r_retry_ok += 1
+                    if not leg:
+                        self.r_ok += 1
+                        if attempt:
+                            self.r_retry_ok += 1
                 else:
                     self.r_passthrough_error += 1
             return status, data
@@ -817,6 +1001,135 @@ class FleetSupervisor:
             "error": SHED_UPSTREAM_ERROR,
             "detail": f"both attempts failed; last: {last_err}",
             "retry_after_ms": round(self.policy.probe_secs * 1e3, 1),
+        }).encode()
+
+    def _knn_fanout(self, body: bytes) -> tuple[int, bytes]:
+        """Sharded /v1/knn (ISSUE 20): embed ONCE through the normal
+        routed path, scatter the embedding to one healthy owner of every
+        ANN shard as a `candidates` probe, merge the per-shard rerank
+        lists and vote in pure python (this module is stdlib-only by
+        contract — mocolint R11 — so the replica-side numpy vote in
+        serve/ann.py is REIMPLEMENTED here, byte-equivalent tie-breaks
+        and all). The whole scatter runs under the request's own
+        deadline; shards that miss it are dropped and the answer is
+        flagged `partial: true` — a degraded answer beats a stall."""
+        t_start = time.monotonic()
+        deadline = t_start + self._deadline_s(body)
+        with self._lock:
+            self.r_knn_fanout += 1
+        status, data = self._routed_request("/v1/embed", body, leg=True)
+        if status != 200:
+            return status, data  # the leg already counted the shed
+        try:
+            embedding = json.loads(data)["embedding"]
+        except (ValueError, KeyError, json.JSONDecodeError):
+            self._router_latency.observe(time.monotonic() - t_start)
+            with self._lock:
+                self.r_upstream_error += 1
+            return 502, json.dumps({
+                "error": SHED_UPSTREAM_ERROR,
+                "detail": "embed leg returned a malformed body",
+            }).encode()
+        # one least-outstanding healthy owner per shard, slots reserved
+        # under the lock exactly like pick_backend
+        targets: dict[int, ReplicaState] = {}
+        with self._lock:
+            for r in self.replicas:
+                if (r.healthy and not r.draining and not r.abandoned
+                        and r.proc is not None and r.shard is not None):
+                    cur = targets.get(r.shard)
+                    if cur is None or ((r.outstanding, r.index)
+                                       < (cur.outstanding, cur.index)):
+                        targets[r.shard] = r
+            for r in targets.values():
+                r.outstanding += 1
+        if not targets:
+            self._router_latency.observe(time.monotonic() - t_start)
+            return self._shed_no_backend()
+        probe = json.dumps({"candidates": True,
+                            "embedding": embedding}).encode()
+        results: dict[int, dict] = {}
+        res_lock = threading.Lock()
+
+        def one_shard(shard: int, r: ReplicaState) -> None:
+            try:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0.01:
+                    return  # this shard missed the budget: partial
+                st, raw = self._forward(r, "/v1/knn", probe, remaining)
+                if st != 200:
+                    return
+                ans = json.loads(raw)
+                if not isinstance(ans.get("candidates"), list):
+                    return
+                with res_lock:
+                    results[shard] = ans
+            except (OSError, http.client.HTTPException, ValueError):
+                self.eject(r, "knn_fanout")
+            finally:
+                self.release_backend(r)
+
+        threads = [
+            threading.Thread(target=one_shard, args=(s, r), daemon=True,
+                             name=f"knn-fanout-s{s}")
+            for s, r in sorted(targets.items())
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(max(deadline - time.monotonic(), 0.0) + 0.05)
+        with res_lock:
+            answers = dict(results)
+        elapsed = time.monotonic() - t_start
+        if not answers:
+            self._router_latency.observe(elapsed)
+            expired = time.monotonic() >= deadline
+            with self._lock:
+                if expired:
+                    self.r_deadline_router += 1
+                else:
+                    self.r_upstream_error += 1
+            if expired:
+                return 504, json.dumps({
+                    "error": SHED_DEADLINE_ROUTER,
+                    "detail": "no ANN shard answered inside the "
+                              "fan-out deadline",
+                }).encode()
+            return 502, json.dumps({
+                "error": SHED_UPSTREAM_ERROR,
+                "detail": "every ANN shard leg failed",
+            }).encode()
+        first = next(iter(answers.values()))
+        k = int(first.get("k") or 200)
+        temperature = float(first.get("temperature") or 0.07)
+        merged = []
+        for shard in sorted(answers):
+            for cand in answers[shard]["candidates"]:
+                merged.append((float(cand[0]), int(cand[1])))
+        # global top-k across shards; ties broken toward the LOWER label
+        # — the same (−sim, label) order AnnShard.search emits, so a
+        # 1-shard fan-out reproduces the replica-local answer exactly
+        merged.sort(key=lambda c: (-c[0], c[1]))
+        votes: dict[int, float] = {}
+        for sim, label in merged[:k]:
+            votes[label] = votes.get(label, 0.0) + math.exp(
+                sim / max(temperature, 1e-8))
+        # max() keeps the FIRST maximum while scanning ascending labels:
+        # lowest label wins ties, matching np.argmax in ann.vote
+        pred = max(sorted(votes), key=lambda lab: votes[lab])
+        partial = len(answers) < self.ann_shards
+        self._router_latency.observe(elapsed)
+        self._knn_merge.observe(elapsed)
+        with self._lock:
+            self.r_ok += 1
+            if partial:
+                self.r_knn_partial += 1
+        return 200, json.dumps({
+            "class": int(pred),
+            "cached": False,
+            "partial": partial,
+            "shards": self.ann_shards,
+            "shards_answered": len(answers),
         }).encode()
 
     def _forward(self, r: ReplicaState, path: str, body: bytes,
@@ -875,16 +1188,24 @@ class FleetSupervisor:
             target = self._target_step
             bank = self._good_bank
         try:
-            # dual-swap fleets (ISSUE 16) pin the deployed BANK into the
-            # relaunch argv alongside the weights: a replica dying after
-            # a dual swap must boot on the (weights, bank) pair, never
-            # new weights over its boot-time bank (cross-space answers)
+            # sharded-ANN fleets (ISSUE 20) pin the replica's cell
+            # partition into the argv alongside the ISSUE 16 pair: a
+            # relaunched replica must come back serving ITS shard
             argv = self._child_argv(r.index, r.port, r.telemetry_dir,
-                                    pretrained, bank)
+                                    pretrained, bank, r.shard)
         except TypeError:
-            # 4-arg child_argv (bank-free fleets, older test stubs)
-            argv = self._child_argv(r.index, r.port, r.telemetry_dir,
-                                    pretrained)
+            try:
+                # dual-swap fleets (ISSUE 16) pin the deployed BANK into
+                # the relaunch argv alongside the weights: a replica
+                # dying after a dual swap must boot on the (weights,
+                # bank) pair, never new weights over its boot-time bank
+                # (cross-space answers)
+                argv = self._child_argv(r.index, r.port, r.telemetry_dir,
+                                        pretrained, bank)
+            except TypeError:
+                # 4-arg child_argv (bank-free fleets, older test stubs)
+                argv = self._child_argv(r.index, r.port, r.telemetry_dir,
+                                        pretrained)
         env = dict(os.environ if self._env is None else self._env)
         env.update(self.tracer.child_env())
         env.update(self._replica_env.get(r.index, {}))
@@ -936,6 +1257,7 @@ class FleetSupervisor:
         now = time.monotonic()
         with self._lock:
             expected = r.expected_exit
+            reaping = r.reaping
             progressed = r.ever_healthy_life
             pid = r.pid
             r.proc = None
@@ -946,8 +1268,12 @@ class FleetSupervisor:
         self._emit("replica_exit", replica=r.index, pid=pid, returncode=rc,
                    classification=cls, detail=detail,
                    progressed=progressed, expected=expected)
-        if expected:
-            return  # the roll machine (or stop()) owns the relaunch
+        if expected or reaping:
+            # the roll machine, stop(), or the autoscale reap owns this
+            # death — a reaping replica is never relaunched, even when
+            # it crashed before our SIGTERM landed (the reap removes it
+            # from the table on the next monitor pass either way)
+            return
         if cls in FATAL_CLASSES and cls != CLASS_CLEAN:
             # CLEAN is fatal for a RUN supervisor (the run is over); a
             # serve fleet wants N replicas — an unexpected clean exit
@@ -1095,13 +1421,24 @@ class FleetSupervisor:
             time.sleep(0.05)
         return False
 
+    def _replica_by_index(self, index: int) -> ReplicaState | None:
+        """Replica lookup by its STABLE index. List position stopped
+        being the index once the autoscaler started appending and
+        reaping replicas (ISSUE 20); None means it was reaped."""
+        with self._lock:
+            for r in self.replicas:
+                if r.index == index:
+                    return r
+        return None
+
     def _advance_roll(self, now: float) -> None:
         with self._lock:
             if self._roll is None:
                 if not self._roll_requested:
                     return
                 self._roll_requested = False
-                queue = [r.index for r in self.replicas if not r.abandoned]
+                queue = [r.index for r in self.replicas
+                         if not r.abandoned and not r.reaping]
                 if not queue:
                     return
                 self._roll = {"queue": queue, "idx": None,
@@ -1123,7 +1460,15 @@ class FleetSupervisor:
                     self._roll = None
                 return
             idx = roll["queue"][0]
-            r = self.replicas[idx]
+            r = self._replica_by_index(idx)
+            if r is None or r.reaping:
+                # reaped by the autoscaler since roll-begin: it is on
+                # its way out of the table — nothing to roll
+                with self._lock:
+                    roll["queue"].pop(0)
+                self._emit("roll_replica", replica=idx, phase="skipped",
+                           reason="reaped")
+                return
             if r.abandoned:
                 # abandoned since roll-begin: it will never come alive —
                 # skip it, or the roll (and every future roll) wedges
@@ -1139,6 +1484,7 @@ class FleetSupervisor:
                 others_ok = all(
                     c.healthy for c in self.replicas
                     if c.index != idx and not c.abandoned
+                    and not c.reaping
                 )
             if not others_ok or not r.alive():
                 return  # wait for the fleet to be whole first
@@ -1152,7 +1498,11 @@ class FleetSupervisor:
             self._emit("roll_replica", replica=idx, phase="drain")
             r.proc.terminate()         # serve.py drains + exits EXIT_OK
             return
-        r = self.replicas[roll["idx"]]
+        r = self._replica_by_index(roll["idx"])
+        if r is None:
+            with self._lock:
+                roll["idx"] = None  # reaped mid-roll: move on
+            return
         if roll["phase"] == "wait_exit":
             if r.proc is None:         # _handle_exit consumed the death
                 with self._lock:
@@ -1507,8 +1857,12 @@ class FleetSupervisor:
         poll = max(min(self.policy.probe_secs / 2.0, 0.5), 0.02)
         while not self._stop.is_set():
             now = time.monotonic()
-            for r in self.replicas:
+            # snapshot: the autoscaler appends and reaps mid-iteration
+            for r in list(self.replicas):
                 if r.abandoned:
+                    continue
+                if r.reaping:
+                    self._advance_reap(r, now)
                     continue
                 if r.proc is None:
                     with self._lock:
@@ -1547,7 +1901,136 @@ class FleetSupervisor:
                 with self._lock:
                     self._last_stats_event = now
                 self._emit_router_stats()
+                # the autoscaler consumes the SAME windowed stream it
+                # just emitted: one cadence, one source of truth
+                self._autoscale_tick(now)
             self._stop.wait(poll)
+
+    # -- autoscaling (ISSUE 20) ----------------------------------------------
+    def _autoscale_tick(self, now: float) -> None:
+        """One controller observation per stats emit. The snapshot fed
+        to the controller is the SAME shape `_emit_router_stats` just
+        wrote, so an operator replaying events.jsonl through an
+        AutoscaleController reproduces every decision."""
+        if self.policy.autoscale_max <= 0:
+            return
+        with self._lock:
+            stats = self._router_counters()
+            stats["healthy"] = sum(
+                1 for r in self.replicas
+                if r.healthy and not r.draining and not r.abandoned
+            )
+            stats["outstanding"] = sum(
+                r.outstanding for r in self.replicas)
+        if self._router_latency.count:
+            stats["latency_ms"] = self._router_latency.percentiles_ms()
+        decision = self._autoscaler.observe(stats, now)
+        if decision is None:
+            return
+        action, reason = decision
+        if action == "up":
+            self._scale_up(reason)
+        else:
+            self._scale_down(reason)
+
+    def _active_replicas(self) -> list[ReplicaState]:
+        # caller holds the lock
+        return [r for r in self.replicas
+                if not r.abandoned and not r.reaping]
+
+    def _scale_up(self, reason: str) -> None:
+        with self._lock:
+            if len(self._active_replicas()) >= self.policy.autoscale_max:
+                return  # at the replica budget: breach stays visible in
+                # router_stats; capacity does not follow
+            index = self._next_index
+            self._next_index += 1
+        port = (self._base_port + index if self._base_port
+                else pick_free_port(self.host))
+        rdir = os.path.join(self.telemetry_dir, f"replica{index}")
+        try:
+            os.makedirs(rdir, exist_ok=True)
+        except OSError as e:
+            self._emit("autoscale_error", replica=index,
+                       detail=f"cannot create {rdir!r}: {e}")
+            return
+        r = ReplicaState(index, self.host, port, rdir,
+                         self.policy.max_restarts)
+        if self.ann_shards:
+            r.shard = index % self.ann_shards
+        with self._lock:
+            self.replicas.append(r)
+            total = len(self.replicas)
+        self._emit("autoscale_up", replica=index, port=port,
+                   shard=r.shard, reason=reason, replicas=total)
+        self._try_launch(r)
+
+    def _scale_down(self, reason: str) -> None:
+        with self._lock:
+            active = self._active_replicas()
+            # the floor: operator minimum, and never below one healthy
+            # owner per ANN cell partition (shard cover)
+            floor = max(self.policy.autoscale_min, self.ann_shards, 1)
+            if len(active) <= floor:
+                return
+
+            def reapable(v: ReplicaState) -> bool:
+                if not v.healthy or v.draining:
+                    return False
+                if v.shard is None:
+                    return True
+                # shard-cover guard: never reap a partition's last
+                # healthy owner
+                return any(
+                    c is not v and c.shard == v.shard and c.healthy
+                    and not c.draining
+                    for c in active
+                )
+
+            cands = [r for r in active if reapable(r)]
+            if not cands:
+                return
+            victim = max(cands, key=lambda r: r.index)
+            victim.reaping = True
+            victim.draining = True  # the router stops picking it NOW
+            total = len(self.replicas)
+        self._emit("autoscale_down", replica=victim.index,
+                   shard=victim.shard, reason=reason, replicas=total)
+
+    def _advance_reap(self, r: ReplicaState, now: float) -> None:
+        """Drain-then-reap, one monitor pass at a time: `draining`
+        already keeps new picks away, so wait for the router's
+        in-flight count to hit zero, SIGTERM (serve.py finishes
+        accepted work and exits cleanly), escalate a straggler past
+        the grace window, and drop the replica from the table once the
+        process is gone. Zero accepted requests lost by construction."""
+        if r.proc is None:
+            with self._lock:
+                if r in self.replicas:
+                    self.replicas.remove(r)
+                remaining = len(self.replicas)
+            self._emit("autoscale_reaped", replica=r.index,
+                       replicas=remaining)
+            return
+        if r.proc.poll() is not None:
+            self._handle_exit(r)  # reaping => no relaunch scheduled
+            return
+        term = kill = False
+        with self._lock:
+            if not r.expected_exit:
+                if r.outstanding == 0:
+                    r.expected_exit = True
+                    r.term_at = now
+                    term = True
+            elif now - r.term_at > self.policy.term_grace_secs:
+                r.term_at = now
+                kill = True
+        if term:
+            r.proc.terminate()
+        elif kill:
+            self._emit("kill", replica=r.index, pid=r.pid,
+                       reason="reap_straggler", phase="sigkill")
+            r.proc.kill()
 
     def _emit_router_stats(self, final: bool = False) -> None:
         """The autoscaler input record (ISSUE 12 satellite): one
@@ -1568,6 +2051,17 @@ class FleetSupervisor:
                                               consumer can rate-convert
                                               counter deltas
 
+        ISSUE 20 ADDITIVE keys (a pre-20 consumer keeps working):
+
+          requests_interactive /
+          requests_batch                      cumulative per-tier demand
+          knn_fanout / knn_partial            cumulative sharded-kNN
+                                              scatters / partial answers
+          ann_shards                          cell-partition count
+                                              (absent on ann-free fleets)
+          knn_merge_ms {p50,p95,p99}          end-to-end fan-out latency
+                                              (absent until any fan-out)
+
         Consumers take DELTAS between consecutive records for rates (the
         counters are cumulative — a last-snapshot fold stays valid)."""
         with self._lock:
@@ -1585,5 +2079,9 @@ class FleetSupervisor:
         if self._router_latency.count:
             extras["latency_ms"] = self._router_latency.percentiles_ms()
             extras["window"] = self._router_latency.count
+        if self.ann_shards:
+            extras["ann_shards"] = self.ann_shards
+        if self._knn_merge.count:
+            extras["knn_merge_ms"] = self._knn_merge.percentiles_ms()
         self._emit("router_stats", final=final, healthy=healthy,
                    **counters, **extras)

@@ -7,10 +7,17 @@ ARE the batch. No web framework: the container bakes no server deps, and
 the protocol is four routes of JSON.
 
     POST /v1/embed   {"image_b64": <raw uint8 RGB bytes>, "shape": [S,S,3]}
-                     (or {"pixels": nested list}; optional "deadline_ms")
+                     (or {"pixels": nested list}; optional "deadline_ms",
+                     optional "tier": "interactive"|"batch" — the
+                     admission lane, ISSUE 20)
                  →   200 {"embedding": [...], "cached": bool}
     POST /v1/knn     same body → 200 {"class": int, "cached": bool}
-                     (+"embedding" when "return_embedding" is true)
+                     (+"embedding" when "return_embedding" is true).
+                     With {"candidates": true, "embedding": [...]} —
+                     the fleet router's ANN fan-out leg — answers this
+                     replica's shard-local candidates instead:
+                     {"candidates": [[sim, label], ...], "temperature",
+                     "k", "num_classes", "shard", "shards"}
     POST /admin/reload  {"pretrained": <path>, "step": <int>?,
                      "bank": <path>?, "bank_step": <int>?} → hot weight
                      reload (ISSUE 10): build + warm a new engine
@@ -154,24 +161,43 @@ def _make_handler(service):
                 req = json.loads(self.rfile.read(length) or b"{}")
                 if not isinstance(req, dict):
                     raise ValueError("body must be a JSON object")
-                image = decode_image(req)
                 deadline_ms = req.get("deadline_ms")
                 deadline_s = (
                     float(deadline_ms) / 1e3 if deadline_ms else None
                 )
+                tier = req.get("tier", "interactive")
+                if tier not in ("interactive", "batch"):
+                    raise ValueError(
+                        f'unknown tier {tier!r} ("interactive" or "batch")'
+                    )
+                # ANN candidate probe (ISSUE 20): the fleet router's
+                # fan-out leg carries an EMBEDDING, not an image — no
+                # batcher, no device call, pure index search
+                candidates = (self.path == "/v1/knn"
+                              and req.get("candidates"))
+                image = None if candidates else decode_image(req)
             except (ValueError, json.JSONDecodeError) as e:
                 self._send(400, {"error": "bad_request", "detail": str(e)})
                 return
             try:
+                if candidates:
+                    emb = req.get("embedding")
+                    if not isinstance(emb, list) or not emb:
+                        raise ValueError(
+                            'candidates mode needs "embedding": [...]'
+                        )
+                    self._send(200, service.ann_candidates(emb))
+                    return
                 if self.path == "/v1/knn":
                     cls_id, embedding, cached = service.classify(
-                        image, deadline_s
+                        image, deadline_s, tier=tier
                     )
                     resp = {"class": cls_id, "cached": cached}
                     if req.get("return_embedding"):
                         resp["embedding"] = [float(v) for v in embedding]
                 else:
-                    embedding, cached = service.embed(image, deadline_s)
+                    embedding, cached = service.embed(image, deadline_s,
+                                                      tier=tier)
                     resp = {"embedding": [float(v) for v in embedding],
                             "cached": cached}
                 self._send(200, resp)

@@ -139,6 +139,10 @@ class EmbedService:
         reload_min_spread: float = 1e-4,
         knn_bank_meta: dict | None = None,
         bank_agreement_min: float = 0.98,
+        ann=None,
+        admission_tiers: bool = True,
+        batch_max_queue: int | None = None,
+        batch_deadline_ms: float | None = None,
     ):
         self.engine = engine
         self.feat_dim = engine.warmup()  # every bucket compiled before traffic
@@ -182,6 +186,10 @@ class EmbedService:
         # window once per executed batch and surfaces the capture state on
         # /healthz + /stats
         self.tracer = tracer
+        # tiered admission (ISSUE 20): interactive vs batch lanes in the
+        # batcher; admission_tiers=False collapses everything onto the
+        # interactive lane (tier tags are accepted but ignored)
+        self.admission_tiers = bool(admission_tiers)
         self.batcher = MicroBatcher(
             self._run_batch,
             buckets=engine.buckets,
@@ -191,6 +199,8 @@ class EmbedService:
             on_batch=self._note_batch,
             tracer=tracer,
             shed_spike_min=shed_spike_min,
+            batch_max_queue=batch_max_queue,
+            batch_deadline_ms=batch_deadline_ms,
         )
         # dual swap (ISSUE 16): the (engine, generation) pair _run_batch
         # reads atomically, the per-generation bank registry classify()
@@ -217,6 +227,18 @@ class EmbedService:
             # pre-compile the kNN program too: the first classify must not
             # pay a trace under live traffic (same rule as engine.warmup)
             self._knn_predict(np.ones((1, self.feat_dim), np.float32))
+        # sharded ANN (ISSUE 20): an AnnShard replaces the exact vote on
+        # classify() and answers candidate probes for the fleet's fan-out
+        # merge. ann=None keeps the exact path BIT-identical to before.
+        if ann is not None and self._knn is None:
+            raise ValueError("ann requires a configured kNN bank")
+        self._ann = ann
+        self._ann_by_gen: dict = {0: ann} if ann is not None else {}
+        self.ann_candidate_calls = 0
+        # boot-time recall probe vs exact over this shard's rows — the
+        # number obsd's ann_recall_probe objective watches
+        self._ann_recall = (round(ann.recall_probe(), 4)
+                            if ann is not None else None)
         if self.registry is not None:
             self.registry.emit(
                 "serve_start",
@@ -228,6 +250,7 @@ class EmbedService:
                 request_deadline_ms=request_deadline_ms,
                 cache_mb=cache_mb,
                 knn_bank_size=0 if self._knn is None else len(self._knn["bank"]),
+                ann=self._ann is not None,
             )
 
     def _make_knn(self, bank, labels) -> dict:
@@ -257,10 +280,15 @@ class EmbedService:
 
     # -- request paths -------------------------------------------------------
     def embed(self, image: np.ndarray,
-              deadline_s: float | None = None) -> tuple[np.ndarray, bool]:
+              deadline_s: float | None = None,
+              tier: str = "interactive") -> tuple[np.ndarray, bool]:
         """One request: returns `(embedding, cache_hit)` or raises a
         `RejectionError` subclass (overloaded / deadline_exceeded /
-        draining) — the caller always gets a decision."""
+        draining) — the caller always gets a decision. `tier` picks the
+        admission lane (ISSUE 20): "batch" work sheds independently of
+        interactive traffic."""
+        if not self.admission_tiers:
+            tier = "interactive"
         image = self._validate(image)
         with self._lock:
             self.requests += 1
@@ -277,7 +305,7 @@ class EmbedService:
                 self._h_latency.observe(time.monotonic() - t0)
                 return hit, True
         gen = self._engine_gen  # which engine this request is paying for
-        pending = self.batcher.submit(image, deadline_s)
+        pending = self.batcher.submit(image, deadline_s, tier=tier)
         # generous slack over the request deadline: the batcher ALWAYS
         # resolves accepted requests, so this only guards a dead flusher
         result = pending.wait(
@@ -301,14 +329,18 @@ class EmbedService:
         return result, False
 
     def classify(self, image: np.ndarray,
-                 deadline_s: float | None = None) -> tuple[int, np.ndarray, bool]:
+                 deadline_s: float | None = None,
+                 tier: str = "interactive") -> tuple[int, np.ndarray, bool]:
         """kNN-classify against the precomputed feature bank: returns
-        `(class_id, embedding, cache_hit)`."""
+        `(class_id, embedding, cache_hit)`. With an ANN index configured
+        the vote runs over the index's probed cells (this replica's
+        shard view); without one the exact `ops/knn` path is untouched —
+        bit-identical to the pre-ANN `/v1/knn`."""
         if self._knn is None:
             raise ValueError(
                 "no kNN feature bank configured (serve with --knn-bank)"
             )
-        embedding, cached = self.embed(image, deadline_s)
+        embedding, cached = self.embed(image, deadline_s, tier=tier)
         # generation-consistent vote (ISSUE 16): the row is tagged with
         # the generation that embedded it; vote against THAT generation's
         # bank. A cache hit is always current-generation (the cache is
@@ -317,10 +349,46 @@ class EmbedService:
         # back to the current bank — never a silent cross-space vote
         # under a single swap.
         row_gen = getattr(embedding, "gen", None)
+        if self._ann is not None:
+            ann = self._ann_by_gen.get(row_gen, self._ann) \
+                if row_gen is not None else self._ann
+            pred, _n = ann.classify(np.asarray(embedding))
+            return int(pred), embedding, cached
         knn = self._knn_by_gen.get(row_gen, self._knn) \
             if row_gen is not None else self._knn
         pred = self._knn_predict(embedding[None, :], knn=knn)
         return int(pred[0]), embedding, cached
+
+    def ann_candidates(self, embedding) -> dict:
+        """One shard's answer to the fleet router's `/v1/knn` fan-out
+        (ISSUE 20): top candidates among the cells THIS replica owns,
+        as plain JSON-able (sim, label) pairs plus the vote parameters —
+        the stdlib-only router merges across shards and votes without
+        ever importing numpy or serve/ann.py."""
+        if self._ann is None:
+            raise ValueError(
+                "no ANN index configured (serve with --ann-cells and a "
+                "bank built via tools/bank_build.py --ann-cells)"
+            )
+        q = np.asarray(embedding, np.float32).reshape(-1)
+        if q.shape[0] != self.feat_dim:
+            raise ValueError(
+                f"embedding dim {q.shape[0]} != feat_dim {self.feat_dim}"
+            )
+        ann = self._ann
+        sims, labels, _rows = ann.search(q)
+        with self._lock:
+            self.ann_candidate_calls += 1
+        return {
+            "candidates": [[float(s), int(lab)]
+                           for s, lab in zip(sims, labels)],
+            "temperature": ann.temperature,
+            "k": int(self._knn["k"]) if self._knn is not None
+            else ann.rerank,
+            "num_classes": ann.num_classes,
+            "shard": ann.shard,
+            "shards": ann.shards,
+        }
 
     def _knn_predict(self, features: np.ndarray,
                      knn: dict | None = None) -> np.ndarray:
@@ -404,7 +472,7 @@ class EmbedService:
                 e.bank_step = None if self._bank_meta is None \
                     else self._bank_meta.get("step")
                 raise e
-            new_knn = new_meta = None
+            new_knn = new_meta = new_ann = None
             if bank is not None:
                 # the whole pair is vetted BEFORE the factory runs: a
                 # doctored or torn bank must cost hashing, not a
@@ -412,6 +480,14 @@ class EmbedService:
                 bank_feats, bank_labels, new_meta = \
                     self._verify_bank_pair(bank, pretrained, bank_step)
                 new_knn = self._make_knn(bank_feats, bank_labels)
+                if self._ann is not None:
+                    # under a configured ANN index the new bank must
+                    # carry a verified PAIRED index (built by bank_build
+                    # --ann-cells): same rule as bank-under-knn — a bank
+                    # swap that silently dropped to exact (or to a stale
+                    # index) would change answer semantics mid-fleet
+                    new_ann = self._paired_ann(bank, bank_feats,
+                                               bank_labels)
             t0 = time.monotonic()
             try:
                 new_engine = self._engine_factory(pretrained)
@@ -482,6 +558,11 @@ class EmbedService:
                 for g in [g for g in self._knn_by_gen
                           if g < new_gen - 1]:
                     del self._knn_by_gen[g]  # keep current + previous
+                if new_ann is not None:
+                    self._ann_by_gen[new_gen] = new_ann
+                    for g in [g for g in self._ann_by_gen
+                              if g < new_gen - 1]:
+                        del self._ann_by_gen[g]
             elif self._knn is not None:
                 # bank-less swap on a bank-free service never gets here
                 # (the refusal above); this re-registers the unchanged
@@ -502,6 +583,9 @@ class EmbedService:
                 self._knn = new_knn
                 self._bank_meta = new_meta
                 self._bank_swaps += 1
+                if new_ann is not None:
+                    self._ann = new_ann
+                    self._ann_recall = round(new_ann.recall_probe(), 4)
             entry = {
                 "step": step,
                 "pretrained": pretrained,
@@ -586,6 +670,41 @@ class EmbedService:
                 f"{np.asarray(labels).shape}"
             )
         return feats, labels, meta
+
+    def _paired_ann(self, bank: str, bank_feats, bank_labels):
+        """Load + vet the ANN index paired with an offered bank (ISSUE
+        20). Same taxonomy as the bank itself: no manifest yet -> plain
+        ValueError (the builder writes the index after the bank and the
+        manifest last — retry once it lands); a present-but-torn or
+        mispaired index -> `BankMismatchError` (quarantine the pair)."""
+        from moco_tpu.serve import ann as annmod
+
+        try:
+            loaded = annmod.load_ann(bank)
+        except annmod.AnnIndexError as e:
+            raise BankMismatchError(
+                f"paired ANN index for bank {bank!r} is bad: {e}"
+            ) from e
+        if loaded is None:
+            raise ValueError(
+                f"bank {bank!r} has no ANN index manifest yet — the "
+                "index is built after the bank (manifest last), so this "
+                "build may still be in flight; retry once it lands"
+            )
+        arrays, _manifest = loaded
+        old = self._ann
+        try:
+            return annmod.AnnShard(
+                bank_feats, bank_labels, arrays,
+                shard=old.shard, shards=old.shards, nprobe=old.nprobe,
+                rerank=old.rerank, temperature=old.temperature,
+                num_classes=self._knn_defaults["num_classes"],
+            )
+        except (annmod.AnnIndexError, ValueError) as e:
+            raise BankMismatchError(
+                f"paired ANN index for bank {bank!r} does not fit the "
+                f"bank: {e}"
+            ) from e
 
     def _bank_agreement(self, new_engine, meta, feat_dim: int,
                         bank: str) -> float:
@@ -702,7 +821,23 @@ class EmbedService:
             "queue_wait_ms": self._h_queue_wait.percentiles_ms(),
             "draining": self.draining,
             "uptime_s": round(time.monotonic() - self._started, 1),
+            # per-tier admission breakdown (ISSUE 20); the flat
+            # shed_overload/shed_deadline above stay cross-tier TOTALS
+            "tiers": {
+                "submitted": dict(b.submitted_by_tier),
+                "shed_overload": dict(b.shed_overload_by_tier),
+                "shed_deadline": dict(b.shed_deadline_by_tier),
+                "queue_depth": b.queue_depth_by_tier,
+            },
         }
+        if self._ann is not None:
+            with self._lock:
+                candidate_calls = self.ann_candidate_calls
+            out["ann"] = dict(
+                self._ann.stats(),
+                recall_probe=self._ann_recall,
+                candidate_calls=candidate_calls,
+            )
         with self._lock:
             if self.reloads:
                 out["reloads"] = self.reloads

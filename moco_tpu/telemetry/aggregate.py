@@ -418,6 +418,19 @@ class RunWindow:
                                         paired banks and the fleet is
                                         pinned on an aging pair
           resize_relaunches             resize_relaunch records in window
+          ann_recall_probe              last serve snapshot's seeded
+                                        ANN-vs-exact recall@1 probe
+                                        (ISSUE 20) — the quantizer's
+                                        standing quality gauge; absent
+                                        on exact-only services
+          knn_partial_rate              router window delta (ISSUE 20):
+                                        partial fan-out answers /
+                                        fan-outs — sustained partials
+                                        mean a shard can't make the
+                                        deadline
+          autoscale_events              autoscale_up + autoscale_down
+                                        actions in window (flapping
+                                        capacity is its own incident)
           stale_s                       seconds since the newest record
           event:<name>                  count of that event name in window
           health:<key>                  windowed MEAN of that key in the
@@ -534,6 +547,24 @@ class RunWindow:
         if name == "resize_relaunches":
             return float(self.event_count(("resize_relaunch",),
                                           window_s, now))
+        if name == "ann_recall_probe":
+            ann = (self.last_serve or {}).get("ann")
+            if isinstance(ann, dict) and isinstance(
+                    ann.get("recall_probe"), (int, float)):
+                return float(ann["recall_probe"])
+            return None
+        if name == "knn_partial_rate":
+            delta = self._counter_delta(
+                self._router, window_s, now,
+                lambda r: (float(r.get("knn_partial", 0)),
+                           float(r.get("knn_fanout", 0))))
+            if delta is None:
+                return None
+            partial, fanout = delta
+            return partial / fanout if fanout else 0.0
+        if name == "autoscale_events":
+            return float(self.event_count(
+                ("autoscale_up", "autoscale_down"), window_s, now))
         if name == "stale_s":
             if self.last_seen == float("-inf"):
                 return None

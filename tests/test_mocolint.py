@@ -762,3 +762,87 @@ from moco_tpu.train import train_loop
     findings = run_on(tmp_path, "tools/bank_build.py", body,
                       select=("R6",))
     assert "R6" in rules_of(findings)
+
+
+# -- ISSUE 20: the sharded-ANN lint surface ----------------------------------
+
+
+def test_ann_module_is_jax_free_boundary(tmp_path):
+    """The ann-jax-free boundary (R6): the IVF index builder runs inside
+    bank_build's batch lane and inside serve replicas — a jax import
+    there would drag the train runtime into both."""
+    findings = run_on(tmp_path, "moco_tpu/serve/ann.py",
+                      "import jax\n", select=("R6",))
+    assert "R6" in rules_of(findings)
+
+
+def test_ann_module_numpy_is_fine(tmp_path):
+    # numpy IS the index's substrate; only jax/flax/train are banned
+    body = """\
+import json
+import numpy as np
+
+
+def centroids(x):
+    return np.zeros((4, x.shape[1]), dtype=np.float32)
+"""
+    assert run_on(tmp_path, "moco_tpu/serve/ann.py", body,
+                  select=("R6",)) == []
+
+
+def test_r13_covers_ann_index_writes(tmp_path):
+    """R13's scope now includes serve/ann.py: a bare np.savez of
+    ann.npz reopens the torn-artifact window next to a good bank —
+    index writes must go through the atomic_* helpers, manifest last."""
+    body = """\
+import numpy as np
+
+
+def write_index(path, centroids):
+    np.savez(path, centroids=centroids)          # in place: flagged
+
+
+def atomic_save_npz(path, arrays):
+    import os
+    np.savez(path + ".tmp", **arrays)            # inside helper: fine
+    os.replace(path + ".tmp", path)
+"""
+    findings = run_on(tmp_path, "moco_tpu/serve/ann.py", body,
+                      select=("R13",))
+    assert rules_of(findings) == ["R13"]
+    assert findings[0].line == 5
+
+
+def test_r9_covers_ann_kmeans_determinism(tmp_path):
+    """ann.py is a bit-identity module (R9): an unseeded RNG in the
+    k-means init would make the 1-shard and N-shard index builds
+    diverge — the byte-identical artifact contract."""
+    body = """\
+import numpy as np
+
+
+def init(x, k):
+    return x[np.random.permutation(len(x))[:k]]  # global rng: flagged
+"""
+    findings = run_on(tmp_path, "moco_tpu/serve/ann.py", body,
+                      select=("R9",))
+    assert "R9" in rules_of(findings)
+
+
+def test_fleet_router_cannot_import_the_ann_module(tmp_path):
+    """The router merges fan-out candidates in pure python BECAUSE the
+    fleet is stdlib-only (R11): reaching into serve/ann.py would pull
+    numpy into the last process standing."""
+    (tmp_path / "moco_tpu" / "serve").mkdir(parents=True)
+    (tmp_path / "moco_tpu" / "__init__.py").write_text("")
+    (tmp_path / "moco_tpu" / "serve" / "__init__.py").write_text("")
+    (tmp_path / "moco_tpu" / "serve" / "ann.py").write_text(
+        "import numpy as np\n"
+    )
+    (tmp_path / "moco_tpu" / "serve" / "fleet.py").write_text(
+        "from moco_tpu.serve.ann import vote\n"
+    )
+    found = Engine(DEFAULT_CONFIG, select=("R11",)).run(
+        [str(tmp_path / "moco_tpu")]).findings
+    assert any(f.path.endswith("fleet.py") and "numpy" in f.message
+               for f in found), [f.human() for f in found]

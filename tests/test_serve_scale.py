@@ -1,0 +1,793 @@
+"""Planet-scale kNN serving suite (ISSUE 20).
+
+Four layers, mirroring tests/test_fleet.py:
+  - index units (no jax): build determinism across bank shard counts
+    (byte-identical ann.npz), manifest pairing + torn/drifted-artifact
+    rejection, the recall@1 >= 0.95 acceptance gate, shard-union ==
+    full-index search, and the numpy-vote vs router-python-vote
+    tie-break equivalence;
+  - router fan-out against in-thread stub shards serving REAL AnnShard
+    candidates: merged fan-out class == the single full-index classify,
+    dead-shard partial flagging, 1-shard fleets never fan out, per-tier
+    router accounting;
+  - admission tiers: a batch-lane flood sheds batch work only — the
+    interactive lane admits through saturation (the starvation drill);
+  - autoscaling: AutoscaleController hysteresis/cooldown as a pure
+    unit, config validation (constructor + serve_fleet CLI exit 45),
+    stub-replica scale-up/drain-reap mechanics, and a load-driven
+    surge -> scale-up -> idle -> reap e2e; the full CLI drill
+    (serve_bench --autoscale-drill) runs as the slow soak.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from moco_tpu.config import ServeConfig
+from moco_tpu.serve import ann as annmod
+from moco_tpu.serve.ann import AnnIndexError, AnnShard, build_ann_index
+from moco_tpu.serve.bankbuild import build_bank
+from moco_tpu.serve.batcher import MicroBatcher, OverloadedError
+from moco_tpu.serve.fleet import (
+    AutoscaleController,
+    FleetPolicy,
+    FleetSupervisor,
+    ReplicaState,
+    pick_free_port,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_POLICY = dict(
+    probe_secs=0.1, probe_timeout_s=0.5, health_stale_secs=1.0,
+    startup_grace_secs=15.0, term_grace_secs=1.0,
+    backoff_base_secs=0.05, backoff_max_secs=0.2, backoff_jitter=0.0,
+    request_timeout_s=10.0, watch_poll_secs=0.1, stats_every_secs=1.0,
+)
+
+D = 8  # stub embedding dim
+
+
+def _embed_stub(batch):
+    flat = np.asarray(batch, np.float32).reshape(len(batch), -1)
+    return (flat[:, :D] / 255.0).astype(np.float32)
+
+
+def _corpus(n=256, seed=3, size=8):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, (n, size, size, 3), dtype=np.uint8)
+    labels = (np.arange(n) % 5).astype(np.int64)
+    return images, labels
+
+
+def _bank(tmp_path, name="bank", step=7, n=256, shards=1):
+    """A real versioned bank on disk (the artifact ANN indexes pair
+    with); returns its root dir."""
+    images, labels = _corpus(n)
+    ck_dir = tmp_path / "export" / str(step)
+    ck_dir.mkdir(parents=True, exist_ok=True)
+    ck = ck_dir / "encoder.npz"
+    if not ck.exists():
+        ck.write_bytes(b"weights " * 512)
+    bank_dir = tmp_path / name
+    build_bank(str(bank_dir), step, images, labels, _embed_stub,
+               checkpoint_path=str(ck), image_size=8, shards=shards)
+    return str(bank_dir)
+
+
+def _load_index(bank_dir, step=7, cells=16):
+    if not os.path.exists(annmod.ann_manifest_path(bank_dir, step)):
+        build_ann_index(bank_dir, step, cells=cells)
+    feats = np.load(os.path.join(bank_dir, str(step), "bank.npz"))
+    arrays, manifest = annmod.load_ann(
+        os.path.join(bank_dir, str(step), "bank.npz"))
+    return feats["features"], feats["labels"], arrays, manifest
+
+
+def _wait(cond, timeout_s=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# index build: determinism, pairing, recall
+# ---------------------------------------------------------------------------
+
+
+def test_ann_build_byte_identical_across_bank_shard_counts(tmp_path):
+    """ISSUE 20 acceptance: bank bytes are already shard-count
+    invariant (ISSUE 16) and the index build is a pure function of
+    those bytes + (cells, seed) — so 1-shard and 3-shard builds yield
+    byte-identical ann.npz files and equal manifests."""
+    b1 = _bank(tmp_path, "b1", shards=1)
+    b3 = _bank(tmp_path, "b3", shards=3)
+    m1 = build_ann_index(b1, 7, cells=16)
+    m3 = build_ann_index(b3, 7, cells=16)
+    p1 = annmod.ann_index_path(b1, 7)
+    p3 = annmod.ann_index_path(b3, 7)
+    assert open(p1, "rb").read() == open(p3, "rb").read()
+    assert m1 == m3
+    # and a REBUILD over the same bank is a byte-level no-op
+    build_ann_index(b1, 7, cells=16)
+    assert open(p1, "rb").read() == open(p3, "rb").read()
+
+
+def test_ann_manifest_pairs_and_rejects_torn_or_drifted(tmp_path):
+    bank_dir = _bank(tmp_path)
+    bank_npz = os.path.join(bank_dir, "7", "bank.npz")
+    # no index built yet: load_ann is None (exact fallback), no error
+    assert annmod.load_ann(bank_npz) is None
+    manifest = build_ann_index(bank_dir, 7, cells=8)
+    assert annmod.verify_ann(bank_dir, 7) is None
+    assert manifest["bank"]["sha256"] and manifest["checkpoint_sha256"]
+    arrays, loaded = annmod.load_ann(bank_npz)
+    assert loaded["cells"] == 8 and set(arrays) == {
+        "centroids", "row_order", "cell_offsets"}
+    # torn index: present-but-wrong bytes must raise, never silently
+    # fall back to exact over a corrupt artifact
+    with open(annmod.ann_index_path(bank_dir, 7), "ab") as f:
+        f.write(b"torn")
+    assert "size mismatch" in annmod.verify_ann(bank_dir, 7)
+    with pytest.raises(AnnIndexError, match="rejected"):
+        annmod.load_ann(bank_npz)
+
+
+def test_ann_rejects_bank_drift_under_index(tmp_path):
+    bank_dir = _bank(tmp_path)
+    build_ann_index(bank_dir, 7, cells=8)
+    bank_npz = os.path.join(bank_dir, "7", "bank.npz")
+    data = dict(np.load(bank_npz))
+    data["features"] = data["features"] + 1.0
+    np.savez(bank_npz, **data)  # simulated out-of-band drift
+    assert "drifted" in annmod.verify_ann(bank_dir, 7)
+
+
+def test_ann_recall_probe_gate(tmp_path):
+    """The acceptance pin: seeded ANN-vs-exact recall@1 >= 0.95 with a
+    REAL approximation in play (nprobe 4 of 16 cells)."""
+    bank_dir = _bank(tmp_path)
+    features, labels, arrays, _ = _load_index(bank_dir)
+    shard = AnnShard(features, labels, arrays, nprobe=4, rerank=50)
+    assert shard.recall_probe() >= 0.95
+    # deterministic: same index + seed => same score
+    assert shard.recall_probe() == shard.recall_probe()
+    # true shards measure against their OWN partition
+    for s in range(2):
+        half = AnnShard(features, labels, arrays, shard=s, shards=2,
+                        nprobe=4, rerank=50)
+        assert half.recall_probe() >= 0.95
+        assert half.owned_rows < features.shape[0]
+
+
+def test_shard_union_reproduces_full_index_search(tmp_path):
+    """Cell partitioning is a pure split: with every owned cell probed,
+    merging per-shard candidates by the router's (-sim, label) order
+    reproduces the full-index top-k row set exactly."""
+    bank_dir = _bank(tmp_path)
+    features, labels, arrays, manifest = _load_index(bank_dir)
+    cells = manifest["cells"]
+    full = AnnShard(features, labels, arrays, nprobe=cells, rerank=10)
+    shards = [AnnShard(features, labels, arrays, shard=s, shards=3,
+                       nprobe=cells, rerank=10) for s in range(3)]
+    assert sum(s.owned_rows for s in shards) == features.shape[0]
+    rng = np.random.default_rng(11)
+    for q in rng.standard_normal((8, D)).astype(np.float32):
+        sims_f, _labels_f, rows_f = full.search(q, k=10)
+        merged = []
+        for s in shards:
+            sims, labs, rows = s.search(q, k=10)
+            merged += list(zip(sims.tolist(), rows.tolist()))
+        merged.sort(key=lambda c: (-c[0], c[1]))
+        assert [r for _s, r in merged[:10]] == rows_f.tolist()
+
+
+def test_vote_tie_breaks_to_lowest_label():
+    # the np.argmax semantics the router's pure-python max(sorted(...))
+    # merge must reproduce
+    assert annmod.vote([(0.5, 3), (0.5, 1)], 0.07, 5) == 1
+    assert annmod.vote([(0.9, 4), (0.1, 0)], 0.07, 5) == 4
+    # two candidates of one class outweigh one slightly-better one
+    assert annmod.vote([(0.50, 2), (0.49, 2), (0.52, 0)], 1.0, 3) == 2
+
+
+def test_ann_manifest_records_the_full_pairing_chain(tmp_path):
+    """The index manifest binds index sha -> bank sha -> checkpoint
+    sha: the chain a replica walks before trusting the artifact."""
+    bank_dir = _bank(tmp_path, "bank2", n=32)
+    manifest = build_ann_index(bank_dir, 7, cells=4)
+    assert manifest["cells"] == 4 and manifest["rows"] == 32
+    assert os.path.exists(annmod.ann_manifest_path(bank_dir, 7))
+    with open(os.path.join(bank_dir, ".integrity", "7.json")) as f:
+        bank_manifest = json.load(f)
+    assert (manifest["checkpoint_sha256"]
+            == bank_manifest["checkpoint"]["sha256"])
+    assert (manifest["bank"]["sha256"]
+            == bank_manifest["files"]["bank.npz"]["sha256"])
+
+
+# ---------------------------------------------------------------------------
+# router fan-out (in-thread stub shards, no child processes)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    pid = 4242
+
+    def poll(self):
+        return None
+
+
+def _shard_backend(embedding, shard_obj=None, answer=None):
+    """A stub replica: /v1/embed answers `embedding`; a candidates
+    probe answers its REAL AnnShard's search (or a canned `answer`)."""
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(n) or b"{}")
+            if self.path == "/v1/knn" and req.get("candidates"):
+                if answer is not None:
+                    resp = answer
+                else:
+                    sims, labs, _rows = shard_obj.search(
+                        np.asarray(req["embedding"], np.float32))
+                    resp = {
+                        "candidates": [[float(s), int(lab)]
+                                       for s, lab in zip(sims, labs)],
+                        "temperature": shard_obj.temperature,
+                        "k": shard_obj.rerank,
+                        "num_classes": shard_obj.num_classes,
+                    }
+            elif self.path == "/v1/knn":
+                resp = {"class": 42, "cached": False}  # exact-path stub
+            else:
+                resp = {"embedding": list(embedding)}
+            body = json.dumps(resp).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    class S(ThreadingHTTPServer):
+        daemon_threads = True
+
+    srv = S(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _router_fleet(tmp_path, ports, ann_shards=0):
+    fleet = FleetSupervisor(
+        lambda *a: ["true"], replicas=len(ports),
+        telemetry_dir=str(tmp_path / "fleet_t"),
+        policy=FleetPolicy(**FAST_POLICY), ann_shards=ann_shards,
+    )
+    for i, port in enumerate(ports):
+        r = ReplicaState(i, "127.0.0.1", port,
+                         str(tmp_path / f"r{i}"), budget=3)
+        r.proc = _FakeProc()
+        r.healthy = True
+        if ann_shards:
+            r.shard = i % ann_shards
+        fleet.replicas.append(r)
+    return fleet
+
+
+def test_fanout_merge_matches_full_index_classify(tmp_path):
+    """The tentpole correctness pin: a 2-shard fan-out through the
+    stdlib-only router — real AnnShard candidates, pure-python merge +
+    vote — answers EXACTLY what a single full-index replica answers."""
+    bank_dir = _bank(tmp_path)
+    features, labels, arrays, manifest = _load_index(bank_dir)
+    cells = manifest["cells"]
+    full = AnnShard(features, labels, arrays, nprobe=cells, rerank=50)
+    halves = [AnnShard(features, labels, arrays, shard=s, shards=2,
+                       nprobe=cells, rerank=50) for s in range(2)]
+    rng = np.random.default_rng(5)
+    q = annmod._l2(features[17] + 0.1 * rng.standard_normal(D)
+                   .astype(np.float32))
+    backends = [_shard_backend(q.tolist(), halves[0]),
+                _shard_backend(q.tolist(), halves[1])]
+    fleet = _router_fleet(
+        tmp_path, [b.server_address[1] for b in backends], ann_shards=2)
+    try:
+        status, body = fleet.router_proxy("/v1/knn", b'{"pixels": [0]}')
+        resp = json.loads(body)
+        assert status == 200
+        assert resp["partial"] is False and resp["shards_answered"] == 2
+        assert resp["class"] == full.classify(q)[0]
+        assert fleet.r_knn_fanout == 1 and fleet.r_knn_partial == 0
+        assert fleet.r_ok == 1  # the embed leg did NOT double-count
+        assert fleet.r_requests == 1
+    finally:
+        for b in backends:
+            b.shutdown()
+
+
+def test_fanout_dead_shard_flags_partial(tmp_path):
+    live = _shard_backend([0.5] * D, answer={
+        "candidates": [[0.9, 3], [0.2, 1]],
+        "temperature": 0.07, "k": 10, "num_classes": 5,
+    })
+    dead_port = pick_free_port()
+    fleet = _router_fleet(
+        tmp_path, [live.server_address[1], dead_port], ann_shards=2)
+    try:
+        status, body = fleet.router_proxy(
+            "/v1/knn", b'{"pixels": [0], "deadline_ms": 3000}')
+        resp = json.loads(body)
+        assert status == 200
+        assert resp["partial"] is True and resp["shards_answered"] == 1
+        assert resp["class"] == 3  # shard 0's candidates still vote
+        assert fleet.r_knn_partial == 1
+        # the dead shard owner was ejected for the probe to readmit
+        assert fleet.replicas[1].healthy is False
+    finally:
+        live.shutdown()
+
+
+def test_single_shard_fleet_never_fans_out(tmp_path):
+    """ann_shards <= 1: /v1/knn routes like any request — the replica's
+    own (exact or local-ANN) answer passes through bit-untouched, the
+    exact-fallback acceptance contract at the router layer."""
+    stub = _shard_backend([0.0] * D)
+    fleet = _router_fleet(tmp_path, [stub.server_address[1]],
+                          ann_shards=1)
+    try:
+        status, body = fleet.router_proxy("/v1/knn", b'{"pixels": [0]}')
+        assert status == 200
+        assert json.loads(body) == {"class": 42, "cached": False}
+        assert fleet.r_knn_fanout == 0
+    finally:
+        stub.shutdown()
+
+
+def test_router_counts_tiers(tmp_path):
+    stub = _shard_backend([0.0] * D)
+    fleet = _router_fleet(tmp_path, [stub.server_address[1]])
+    try:
+        fleet.router_proxy("/v1/embed", b'{"pixels": [0]}')
+        fleet.router_proxy("/v1/embed", b'{"tier": "batch"}')
+        fleet.router_proxy("/v1/embed", b'{"tier": "interactive"}')
+        assert fleet.r_tier == {"interactive": 2, "batch": 1}
+        counters = fleet._router_counters()
+        assert counters["requests_interactive"] == 2
+        assert counters["requests_batch"] == 1
+    finally:
+        stub.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# admission tiers: the starvation drill
+# ---------------------------------------------------------------------------
+
+
+def test_batch_flood_never_sheds_interactive():
+    """Saturate the batch lane past its admission depth while the
+    device is gated: batch work sheds, the interactive lane admits
+    through the whole flood."""
+    gate = threading.Event()
+
+    def run_batch(payloads):
+        gate.wait(10.0)
+        return [p for p in payloads]
+
+    b = MicroBatcher(run_batch, buckets=(1, 4), max_queue=8,
+                     batch_max_queue=4, flush_ms=5.0,
+                     default_deadline_ms=5000.0)
+    try:
+        shed = 0
+        for i in range(12):  # 3x the batch lane's depth
+            try:
+                b.submit(i, tier="batch")
+            except OverloadedError:
+                shed += 1
+        assert shed > 0
+        assert b.shed_overload_by_tier["batch"] == shed
+        # the flood is invisible to the interactive lane
+        pending = [b.submit(100 + i) for i in range(4)]
+        assert b.shed_overload_by_tier["interactive"] == 0
+        assert len(pending) == 4
+        gate.set()
+        for p in pending:
+            assert p.wait(10.0) >= 100
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_interactive_drains_before_batch():
+    """Under contention the flusher picks the interactive queue first:
+    people ride ahead of bulk re-embeds."""
+    order = []
+    gate = threading.Event()
+
+    def run_batch(payloads):
+        gate.wait(10.0)
+        order.append(list(payloads))
+        return list(payloads)
+
+    b = MicroBatcher(run_batch, buckets=(1, 2), max_queue=8,
+                     flush_ms=2.0, default_deadline_ms=5000.0)
+    try:
+        batch_p = [b.submit(("b", i), tier="batch") for i in range(2)]
+        time.sleep(0.05)  # let the batch flush start and block on gate
+        inter_p = [b.submit(("i", i)) for i in range(2)]
+        time.sleep(0.05)
+        gate.set()
+        for p in batch_p + inter_p:
+            p.wait(10.0)
+        # the FIRST flush after the gate holds interactive work even
+        # though batch work enqueued earlier
+        later = [tag for flush in order[1:] for tag, _ in flush]
+        if later:
+            first_after = order[1][0][0]
+            assert first_after == "i", order
+    finally:
+        gate.set()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# config validation: ServeConfig, constructor, CLI exit 45
+# ---------------------------------------------------------------------------
+
+
+def test_serve_config_validates_ann_and_tier_knobs():
+    ok = ServeConfig(ann_cells=64, knn_bank="bank.npz", ann_shard=1,
+                     ann_shards=4)
+    assert ok.ann_nprobe == 8
+    with pytest.raises(ValueError, match="ann_cells"):
+        ServeConfig(ann_cells=-1)
+    with pytest.raises(ValueError, match="ann_shard"):
+        ServeConfig(ann_shard=4, ann_shards=4)
+    with pytest.raises(ValueError, match="knn-bank"):
+        ServeConfig(ann_cells=16)
+    with pytest.raises(ValueError, match="batch_max_queue"):
+        ServeConfig(batch_max_queue=2)
+    with pytest.raises(ValueError, match="batch_deadline_ms"):
+        ServeConfig(batch_deadline_ms=0)
+
+
+def test_fleet_constructor_validates_shards_and_autoscale(tmp_path):
+    def mk(**kw):
+        return FleetSupervisor(
+            lambda *a: ["true"], replicas=kw.pop("replicas", 2),
+            telemetry_dir=str(tmp_path / "t"),
+            policy=FleetPolicy(**FAST_POLICY, **kw.pop("policy", {})),
+            **kw,
+        )
+
+    with pytest.raises(ValueError, match="ann_shards"):
+        mk(ann_shards=-1)
+    with pytest.raises(ValueError, match="ann_shards"):
+        mk(replicas=2, ann_shards=3)  # shard cover needs >= N replicas
+    with pytest.raises(ValueError, match="autoscale_min"):
+        mk(policy=dict(autoscale_max=4, autoscale_min=0))
+    with pytest.raises(ValueError, match="autoscale_max"):
+        mk(replicas=3, policy=dict(autoscale_max=2))
+    mk(replicas=2, ann_shards=2, policy=dict(autoscale_max=4))  # clean
+
+
+@pytest.mark.parametrize("flags", [
+    ("--ann-shards", "-1"),
+    ("--replicas", "2", "--ann-shards", "4"),
+    ("--autoscale-max", "1", "--replicas", "2"),
+    ("--autoscale-max", "2", "--autoscale-min", "0"),
+    ("--autoscale-max", "2", "--autoscale-up-after", "0"),
+])
+def test_serve_fleet_cli_bad_scale_flags_exit_45(tmp_path, flags):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_fleet.py"),
+         "--telemetry-dir", str(tmp_path / "t"), "--port", "0",
+         *flags, "--", "true"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 45, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: pure-unit hysteresis, then the fleet mechanics
+# ---------------------------------------------------------------------------
+
+
+def _stats(requests=0, sheds=0, outstanding=0, healthy=1, p99=0.0):
+    return {"requests": requests, "upstream_timeout": sheds,
+            "outstanding": outstanding, "healthy": healthy,
+            "latency_ms": {"p99": p99} if p99 else {}}
+
+
+def _policy(**kw):
+    base = dict(FAST_POLICY)
+    base.update(autoscale_max=4, autoscale_cooldown_s=10.0,
+                autoscale_up_after=2, autoscale_down_after=2,
+                autoscale_shed_high=0.02, autoscale_outstanding_high=4.0,
+                autoscale_idle_low=0.25)
+    base.update(kw)
+    return FleetPolicy(**base)
+
+
+def test_autoscale_shed_breach_needs_consecutive_windows():
+    c = AutoscaleController(_policy())
+    assert c.observe(_stats(100), now=0.0) is None  # no deltas yet
+    assert c.observe(_stats(200, sheds=10), now=1.0) is None  # streak 1
+    action = c.observe(_stats(300, sheds=20), now=2.0)
+    assert action is not None and action[0] == "up"
+    assert "shed_rate" in action[1]
+
+
+def test_autoscale_mixed_window_resets_streaks():
+    c = AutoscaleController(_policy())
+    c.observe(_stats(100), now=0.0)
+    c.observe(_stats(200, sheds=10), now=1.0)          # breach 1
+    c.observe(_stats(300, sheds=10, outstanding=1), now=2.0)  # mixed
+    assert c.breach_streak == 0 and c.idle_streak == 0
+    assert c.observe(_stats(400, sheds=20), now=3.0) is None  # breach 1
+
+
+def test_autoscale_cooldown_defers_but_keeps_streak():
+    c = AutoscaleController(_policy(autoscale_cooldown_s=100.0))
+    c.observe(_stats(100), now=0.0)
+    c.observe(_stats(200, sheds=10), now=1.0)
+    assert c.observe(_stats(300, sheds=20), now=2.0)[0] == "up"
+    # breaches KEEP accumulating through the cooldown...
+    c.observe(_stats(400, sheds=30), now=3.0)
+    assert c.observe(_stats(500, sheds=40), now=4.0) is None
+    assert c.breach_streak >= 2
+    # ...and fire the moment the window reopens
+    assert c.observe(_stats(600, sheds=50), now=200.0)[0] == "up"
+
+
+def test_autoscale_depth_and_p99_breaches():
+    c = AutoscaleController(_policy())
+    c.observe(_stats(100), now=0.0)
+    c.observe(_stats(200, outstanding=10, healthy=2), now=1.0)
+    action = c.observe(_stats(300, outstanding=12, healthy=2), now=2.0)
+    assert action[0] == "up" and "outstanding/healthy" in action[1]
+    # p99 off by default (0.0); armed, it breaches alone
+    c2 = AutoscaleController(_policy(autoscale_p99_high_ms=50.0))
+    c2.observe(_stats(100), now=0.0)
+    c2.observe(_stats(200, p99=80.0), now=1.0)
+    assert c2.observe(_stats(300, p99=90.0), now=2.0)[0] == "up"
+
+
+def test_autoscale_idle_scales_down_zero_sheds_only():
+    c = AutoscaleController(_policy())
+    c.observe(_stats(100), now=0.0)
+    c.observe(_stats(110), now=1.0)                    # idle 1
+    action = c.observe(_stats(120), now=2.0)           # idle 2
+    assert action is not None and action[0] == "down"
+    # ANY shed in the window blocks the idle path
+    c.observe(_stats(130, sheds=21), now=3.0)
+    assert c.idle_streak == 0
+
+
+# -- stub-replica fleet mechanics -------------------------------------------
+
+_SCALE_STUB = textwrap.dedent("""\
+    import argparse, json, os, signal, sys, threading, time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--telemetry-dir", required=True)
+    p.add_argument("--pretrained", default="boot")
+    p.add_argument("--sleep-s", type=float, default=0.0)
+    args, _ = p.parse_known_args()
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        def log_message(self, *a):
+            pass
+        def _send(self, status, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"status": "ok"})
+            else:
+                self._send(404, {"error": "not_found"})
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            if args.sleep_s:
+                time.sleep(args.sleep_s)
+            self._send(200, {"embedding": [1.0, float(args.port)],
+                             "cached": False})
+
+    class S(ThreadingHTTPServer):
+        daemon_threads = True
+        request_queue_size = 128
+
+    srv = S(("127.0.0.1", args.port), H)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda s, f: stop.set())
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    stop.wait()
+    time.sleep(0.05)
+    srv.shutdown()
+    sys.exit(0)
+""")
+
+
+def _scale_fleet(tmp_path, n=1, sleep_s=0.0, **policy_kw):
+    stub = tmp_path / "scale_stub.py"
+    stub.write_text(_SCALE_STUB)
+    kw = dict(FAST_POLICY)
+    kw.update(policy_kw)
+
+    def child_argv(index, port, tdir, pretrained, bank=None, shard=None):
+        return [sys.executable, str(stub), "--port", str(port),
+                "--telemetry-dir", tdir, "--sleep-s", str(sleep_s)]
+
+    return FleetSupervisor(
+        child_argv, replicas=n, telemetry_dir=str(tmp_path / "fleet_t"),
+        policy=FleetPolicy(**kw), seed=0,
+    )
+
+
+def _healthy(fleet):
+    return sum(1 for r in fleet.replicas if r.healthy and not r.draining)
+
+
+def test_scale_up_then_drain_reap_mechanics(tmp_path):
+    """_scale_up spawns a replica on a fresh monotonic index;
+    _scale_down drain-reaps the highest-index one and it is NEVER
+    relaunched — the replica table shrinks for good."""
+    # autoscale_down_after=50: the AUTO idle path must stay quiet so
+    # this test owns every transition it asserts on
+    fleet = _scale_fleet(tmp_path, n=1, autoscale_max=3,
+                         autoscale_cooldown_s=0.1,
+                         autoscale_down_after=50)
+    fleet.start()
+    try:
+        _wait(lambda: _healthy(fleet) == 1, msg="boot replica healthy")
+        fleet._scale_up("test breach")
+        _wait(lambda: _healthy(fleet) == 2, msg="scaled-up replica")
+        assert [r.index for r in fleet.replicas] == [0, 1]
+        fleet._scale_down("test idle")
+        _wait(lambda: len(fleet.replicas) == 1, msg="victim reaped")
+        assert fleet.replicas[0].index == 0
+        time.sleep(0.5)  # a reaped replica must NOT come back
+        assert len(fleet.replicas) == 1
+        events = [e["event"] for e in fleet.incidents]
+        assert "autoscale_up" in events and "autoscale_down" in events
+        assert "autoscale_reaped" in events
+        # indices are never reused: the next spawn is index 2
+        fleet._scale_up("again")
+        _wait(lambda: _healthy(fleet) == 2, msg="third replica")
+        assert [r.index for r in fleet.replicas] == [0, 2]
+    finally:
+        fleet.stop()
+
+
+def test_scale_down_respects_floor_and_shard_cover(tmp_path):
+    fleet = _router_fleet(tmp_path, [1001, 1002], ann_shards=2)
+    # 2 replicas over 2 shards: floor = max(min=1, shards=2) — no reap
+    fleet._scale_down("idle")
+    assert not any(r.reaping for r in fleet.replicas)
+    # 3 replicas, shards (0, 1, 0): replica 2 shares shard 0 — reapable
+    r = ReplicaState(2, "127.0.0.1", 1003, str(tmp_path / "r2"), budget=3)
+    r.proc = _FakeProc()
+    r.healthy = True
+    r.shard = 0
+    fleet.replicas.append(r)
+    fleet._scale_down("idle")
+    assert fleet.replicas[2].reaping and fleet.replicas[2].draining
+    # but replica 1 (sole owner of shard 1) would never have been picked
+    assert not fleet.replicas[1].reaping
+
+
+def test_e2e_load_driven_scale_up_and_down(tmp_path):
+    """The step drill against a live stub fleet: a closed-loop surge
+    drives outstanding/healthy over the breach line — capacity follows;
+    the load stops — the fleet reaps back to its floor. Every request
+    resolves structured (zero lost) through both transitions."""
+    fleet = _scale_fleet(
+        tmp_path, n=1, sleep_s=0.2, stats_every_secs=0.25,
+        autoscale_max=2, autoscale_cooldown_s=0.5,
+        autoscale_up_after=2, autoscale_down_after=2,
+        autoscale_outstanding_high=2.0, autoscale_idle_low=0.5,
+    )
+    fleet.start()
+    outcomes = {"ok": 0, "structured": 0, "lost": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            status, body = fleet.router_proxy(
+                "/v1/embed", b'{"pixels": [0], "tier": "batch"}')
+            try:
+                resp = json.loads(body)
+            except ValueError:
+                resp = None
+            with lock:
+                if status == 200 and isinstance(resp, dict):
+                    outcomes["ok"] += 1
+                elif isinstance(resp, dict) and "error" in resp:
+                    outcomes["structured"] += 1
+                else:
+                    outcomes["lost"] += 1
+
+    try:
+        _wait(lambda: _healthy(fleet) == 1, msg="boot replica healthy")
+        clients = [threading.Thread(target=client, daemon=True)
+                   for _ in range(6)]
+        for t in clients:
+            t.start()
+        _wait(lambda: _healthy(fleet) == 2, timeout_s=15.0,
+              msg="load-driven scale-up")
+        stop.set()
+        for t in clients:
+            t.join(timeout=10.0)
+        _wait(lambda: len(fleet.replicas) == 1, timeout_s=20.0,
+              msg="idle-driven drain-reap")
+        assert outcomes["lost"] == 0, outcomes
+        assert outcomes["ok"] > 0
+        events = [e["event"] for e in fleet.incidents]
+        assert "autoscale_up" in events and "autoscale_reaped" in events
+    finally:
+        stop.set()
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# the full CLI drill (slow): serve_bench --autoscale-drill
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_autoscale_drill_cli_soak(tmp_path):
+    """serve_bench.run_autoscale_drill end-to-end through the
+    serve_fleet CLI: surge -> scale-up within the cooldown ->
+    interactive probes unshedded -> idle -> drain-reap to the floor,
+    zero lost. The acceptance drill, automated."""
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(REPO, "tools", "serve_bench.py"))
+    serve_bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve_bench)
+
+    stub = tmp_path / "scale_stub.py"
+    stub.write_text(_SCALE_STUB)
+    out = serve_bench.run_autoscale_drill(
+        [sys.executable, "-u", str(stub), "--sleep-s", "0.15"],
+        base_replicas=1, concurrency=16, total_requests=600,
+        image_size=8, pool=4, timeout_s=30.0,
+        drill_timeout_s=120.0,
+        fleet_args=[
+            "--autoscale-max", "2", "--autoscale-min", "1",
+            "--autoscale-cooldown-s", "1",
+            "--autoscale-up-after", "2", "--autoscale-down-after", "2",
+            "--autoscale-outstanding-high", "2",
+            "--autoscale-idle-low", "0.5",
+        ],
+    )
+    assert out.get("pass"), out
+    assert out["healthy_peak"] == 2 and out["healthy_end"] == 1
+    assert out["surge"]["lost"] == 0
+    assert out["interactive_probes"]["shed"] == 0
+    assert out["interactive_probes"]["lost"] == 0

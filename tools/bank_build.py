@@ -81,6 +81,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rows per embed call inside one shard")
     p.add_argument("--telemetry-dir", default="",
                    help="emit kind:\"bank\" build events here")
+    p.add_argument("--ann-cells", type=int, default=0,
+                   help="also build the paired IVF ANN index (ISSUE 20): "
+                        "a deterministic k-means coarse quantizer with "
+                        "this many cells, written atomically next to "
+                        "the bank with its own manifest binding "
+                        "index -> bank -> checkpoint; 0 = no index")
+    p.add_argument("--ann-kmeans-iters", type=int, default=10,
+                   help="Lloyd iterations for the --ann-cells quantizer")
     return p
 
 
@@ -182,6 +190,27 @@ def main(argv=None) -> int:
         if registry is not None:
             registry.close()
         return EXIT_CONFIG_ERROR
+    if args.ann_cells:
+        # the index is built AFTER (and bound to) the finished bank: a
+        # fleet seeing a bank manifest without an index manifest knows
+        # the build is still in flight and retries, never mispairs
+        from moco_tpu.serve import ann as annmod
+
+        try:
+            ann_manifest = annmod.build_ann_index(
+                args.bank_dir, step, cells=args.ann_cells,
+                kmeans_iters=args.ann_kmeans_iters, emit=emit,
+            )
+        except (annmod.AnnIndexError, OSError, ValueError) as e:
+            info(f"ann index build failed: {e}")
+            if registry is not None:
+                registry.close()
+            return EXIT_CONFIG_ERROR
+        info(
+            f"ann index step {step}: {ann_manifest['cells']} cells over "
+            f"{ann_manifest['rows']} rows -> "
+            f"{annmod.ann_index_path(args.bank_dir, step)}"
+        )
     if registry is not None:
         registry.close()
     info(

@@ -170,6 +170,10 @@ BIT_IDENTITY_MODULES = (
     # bit-identical for any shard count — a global-RNG draw or wall-clock
     # value in the build path would break the 1-vs-3-shard byte equality
     "moco_tpu/serve/bankbuild.py",
+    # ISSUE 20: the IVF index build is test-pinned byte-identical for
+    # the same (bank, cells, seed) — the seeded k-means and the shard
+    # search/vote must never consult a global RNG or wall clock
+    "moco_tpu/serve/ann.py",
     "moco_tpu/ops/",
     "moco_tpu/parallel/",
 )
@@ -196,7 +200,10 @@ DEFAULT_CONFIG = LintConfig(
         **_R1_R7_SCOPES,
         # R13 (ISSUE 16): bank artifact writes go through the atomic
         # temp+rename helpers — torn artifacts must never look promotable
+        # (ISSUE 20 extends the scope to the ANN index writer: a torn
+        # ann.npz next to a good bank must never look loadable)
         "R13": RuleScope(include=("moco_tpu/serve/bankbuild.py",
+                                  "moco_tpu/serve/ann.py",
                                   "tools/bank_build.py")),
         # R12 (ISSUE 8): span context-manager discipline package-wide +
         # the stdlib-only import diet of telemetry/trace.py (which the
@@ -340,6 +347,20 @@ DEFAULT_CONFIG = LintConfig(
                  "bloat N decode processes and couple their restarts to "
                  "the train stack (the R6 serve rule, applied to the "
                  "input side)"),
+        ),
+        # ISSUE 20: the ANN index layer is pure numpy by contract — a
+        # jax import there would drag the runtime (and a compile cache)
+        # into every shard-serving process and the index builder
+        Boundary(
+            name="ann-jax-free",
+            rule_id="R6",
+            scope=("moco_tpu/serve/ann.py",),
+            forbid=SERVE_FORBIDDEN + ("jax", "flax"),
+            why=("the IVF index builds from and serves numpy bank "
+                 "artifacts; importing jax (let alone the train stack) "
+                 "would couple every ANN shard replica and promotion "
+                 "job to the runtime whose failures the serving tier "
+                 "must survive"),
         ),
         Boundary(
             name="checkpoint-orbax-lazy",

@@ -89,6 +89,29 @@ def build_service(config: ServeConfig):
         # dual-swap (engine, bank) pairs; a plain npz gets meta=None and
         # behaves exactly as before
         knn_bank, knn_labels, knn_bank_meta = load_bank(config.knn_bank)
+    ann_shard = None
+    if config.ann_cells:
+        # sharded ANN (ISSUE 20): a verified paired index must sit next
+        # to the versioned bank; a missing/torn index is a config error
+        # (exit 45), never a silent fall-back to exact
+        from moco_tpu.serve import ann as annmod
+
+        loaded = annmod.load_ann(config.knn_bank)  # AnnIndexError -> 45
+        if loaded is None:
+            raise ValueError(
+                f"--ann-cells {config.ann_cells} but bank "
+                f"{config.knn_bank!r} has no ANN index manifest — build "
+                "it with tools/bank_build.py --ann-cells"
+            )
+        arrays, _manifest = loaded
+        ann_shard = annmod.AnnShard(
+            knn_bank, knn_labels, arrays,
+            shard=config.ann_shard, shards=config.ann_shards,
+            nprobe=config.ann_nprobe,
+            rerank=config.ann_rerank or config.knn_k,
+            temperature=config.knn_temperature,
+            num_classes=config.num_classes,
+        )
     service = EmbedService(
         engine,
         flush_ms=config.flush_ms,
@@ -108,6 +131,10 @@ def build_service(config: ServeConfig):
         reload_min_spread=config.reload_min_spread,
         knn_bank_meta=knn_bank_meta,
         bank_agreement_min=config.bank_agreement_min,
+        ann=ann_shard,
+        admission_tiers=config.admission_tiers,
+        batch_max_queue=config.batch_max_queue,
+        batch_deadline_ms=config.batch_deadline_ms,
     )
     service.set_engine_factory(engine_factory)
     return service, registry

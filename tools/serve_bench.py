@@ -25,7 +25,22 @@ spins up `tools/serve_fleet.py` at each replica count (everything after
 the router, and reports rps/p99/lost per count. `--kill-drill` SIGKILLs
 one replica mid-load (pid from the router's /stats) — the zero-lost
 contract must hold THROUGH the kill: the router's single-retry absorbs
-in-flight failures.
+in-flight failures. `--fleet-args` forwards extra flags to the
+supervisor (e.g. "--ann-shards 4" to bench the sharded kNN fan-out);
+`--tier batch` tags every request for the batch admission lane.
+
+Autoscale drill (ISSUE 20):
+
+    python tools/serve_bench.py --autoscale-drill --requests 2048 \
+        --fleet-args "--autoscale-max 3 --autoscale-cooldown-s 3" -- \
+        python tools/serve.py --pretrained encoder.npz --arch resnet_tiny
+
+one fleet, three acts: a batch-lane surge drives the router's shed rate
+over the breach threshold (capacity must FOLLOW — /healthz grows within
+the cooldown), low-rate interactive probes ride through the whole surge
+(they must see ZERO sheds: the lanes exist so bulk work cannot starve
+people), then the load stops and the fleet must drain-and-reap back to
+its floor. Zero lost accepted requests across every phase, or exit 1.
 
 Pure stdlib + numpy: runs anywhere the server is reachable, no jax.
 """
@@ -133,7 +148,9 @@ def run_load(
     timeout_s: float = 30.0,
     endpoint: str = "/v1/embed",
     seed: int = 0,
+    tier: str = "",
     capture: dict | None = None,
+    stop: threading.Event | None = None,
 ) -> dict:
     """Drive the server; returns the summary dict (see module docstring).
     `capture`, when given, collects `pool_index -> embedding list` from
@@ -149,6 +166,8 @@ def run_load(
                 "shape": list(im.shape)}
         if deadline_ms:
             body["deadline_ms"] = deadline_ms
+        if tier:
+            body["tier"] = tier
         payloads.append(json.dumps(body).encode("utf-8"))
 
     lock = threading.Lock()
@@ -165,6 +184,8 @@ def run_load(
         start_gate.wait()
         try:
             for j in range(n):
+                if stop is not None and stop.is_set():
+                    break
                 k = (wid * 31 + j * 7) % pool  # deterministic mixed replay
                 t0 = time.monotonic()
                 try:
@@ -283,6 +304,7 @@ def run_fleet_bench(
     deadline_ms: float = 0.0,
     endpoint: str = "/v1/embed",
     seed: int = 0,
+    tier: str = "",
     kill_drill: bool = False,
     kill_after_s: float = 1.0,
     boot_timeout_s: float = 240.0,
@@ -330,7 +352,7 @@ def run_fleet_bench(
                 url, concurrency=concurrency,
                 total_requests=total_requests, image_size=image_size,
                 pool=pool, timeout_s=timeout_s, deadline_ms=deadline_ms,
-                endpoint=endpoint, seed=seed,
+                endpoint=endpoint, seed=seed, tier=tier,
             )
             if killer is not None:
                 killer.join(timeout=10.0)
@@ -366,6 +388,168 @@ def run_fleet_bench(
     return rows
 
 
+# ---------------------------------------------------------------------------
+# autoscale step drill (ISSUE 20): surge -> scale up -> idle -> drain-reap
+# ---------------------------------------------------------------------------
+
+
+def _fetch_healthy(url: str) -> int:
+    try:
+        with urllib.request.urlopen(url + "/healthz", timeout=2.0) as r:
+            return int(json.loads(r.read()).get("healthy", 0))
+    except (OSError, ValueError):
+        return -1
+
+
+def run_autoscale_drill(
+    replica_cmd: list,
+    *,
+    base_replicas: int = 1,
+    concurrency: int = 32,
+    total_requests: int = 2048,
+    image_size: int = 224,
+    pool: int = 16,
+    timeout_s: float = 30.0,
+    deadline_ms: float = 0.0,
+    seed: int = 0,
+    boot_timeout_s: float = 240.0,
+    drill_timeout_s: float = 180.0,
+    probe_interval_s: float = 0.25,
+    fleet_args: list | None = None,
+    env: dict | None = None,
+) -> dict:
+    """The ISSUE 20 step drill. Boots ONE fleet at `base_replicas` with
+    autoscaling armed (caller supplies --autoscale-* via fleet_args),
+    then: (1) surge — a batch-lane closed loop saturates the router
+    while low-rate INTERACTIVE probes run beside it; capacity must grow
+    past the starting healthy count before the surge ends. (2) idle —
+    the load stops; the fleet must drain-and-reap back down to its
+    floor within `drill_timeout_s`. Verdict fails on any lost request,
+    any interactive shed during the surge, or either transition not
+    observed."""
+    import shutil
+
+    fleet_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "serve_fleet.py")
+    tdir = tempfile.mkdtemp(prefix="fleet_autoscale_")
+    argv = [
+        sys.executable, "-u", fleet_py,
+        "--replicas", str(base_replicas), "--port", "0", "--base-port", "0",
+        "--telemetry-dir", tdir,
+        "--probe-secs", "0.2", "--probe-timeout-s", "2.0",
+        "--health-stale-secs", "10",
+        "--startup-grace-secs", str(boot_timeout_s),
+        "--backoff-base-secs", "0.1",
+        # the autoscaler observes on the stats cadence: a drill-speed
+        # window so breach/idle streaks accumulate in seconds, not
+        # the production default half-minutes
+        "--stats-every-secs", "0.5",
+    ] + list(fleet_args or []) + ["--"] + list(replica_cmd)
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env,
+    )
+    out: dict = {"base_replicas": base_replicas}
+    probes = {"sent": 0, "ok": 0, "shed": 0, "lost": 0}
+    try:
+        url = _wait_fleet_ready(proc, base_replicas, boot_timeout_s)
+        healthy0 = _fetch_healthy(url)
+        out["healthy_start"] = healthy0
+
+        surge_summary: dict = {}
+        surge_done = threading.Event()
+
+        def _surge():
+            surge_summary.update(run_load(
+                url, concurrency=concurrency,
+                total_requests=total_requests, image_size=image_size,
+                pool=pool, timeout_s=timeout_s, deadline_ms=deadline_ms,
+                endpoint="/v1/embed", seed=seed, tier="batch",
+            ))
+            surge_done.set()
+
+        surge = threading.Thread(target=_surge, daemon=True)
+        t0 = time.monotonic()
+        surge.start()
+
+        # interactive probes beside the surge: ONE request in flight at
+        # a steady trickle — the lane the batch flood must never starve
+        rng = np.random.RandomState(seed + 1)
+        im = rng.randint(0, 256, (image_size, image_size, 3)).astype(np.uint8)
+        probe_payload = json.dumps({
+            "image_b64": base64.b64encode(im.tobytes()).decode("ascii"),
+            "shape": list(im.shape), "tier": "interactive",
+        }).encode("utf-8")
+        probe_client = _Client(url, timeout_s)
+
+        peak = healthy0
+        scale_up_s = None
+        while not surge_done.is_set():
+            probes["sent"] += 1
+            try:
+                status, resp = probe_client.post_json("/v1/embed",
+                                                      probe_payload)
+                if status == 200 and isinstance(resp, dict):
+                    probes["ok"] += 1
+                elif (isinstance(resp, dict)
+                        and resp.get("error") in STRUCTURED_REJECTIONS):
+                    probes["shed"] += 1
+                else:
+                    probes["lost"] += 1
+            except (OSError, TimeoutError, http.client.HTTPException):
+                probes["lost"] += 1
+            h = _fetch_healthy(url)
+            if h > peak:
+                peak = h
+                if scale_up_s is None:
+                    scale_up_s = round(time.monotonic() - t0, 2)
+            surge_done.wait(probe_interval_s)
+        surge.join(timeout=timeout_s)
+        probe_client.close()
+        out["surge"] = surge_summary
+        out["interactive_probes"] = probes
+        out["healthy_peak"] = peak
+        out["scale_up_s"] = scale_up_s
+
+        # idle: no load — the supervisor must drain and reap back down
+        t1 = time.monotonic()
+        scale_down_s = None
+        floor = healthy0
+        while time.monotonic() - t1 < drill_timeout_s:
+            h = _fetch_healthy(url)
+            if 0 <= h <= floor:
+                scale_down_s = round(time.monotonic() - t1, 2)
+                break
+            time.sleep(0.5)
+        out["healthy_end"] = _fetch_healthy(url)
+        out["scale_down_s"] = scale_down_s
+
+        out["pass"] = bool(
+            surge_summary
+            and surge_summary.get("lost", 1) == 0
+            and probes["lost"] == 0
+            and probes["shed"] == 0
+            and peak > healthy0
+            and scale_down_s is not None
+        )
+    except (RuntimeError, OSError) as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+        out["pass"] = False
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        if out.get("pass"):
+            shutil.rmtree(tdir, ignore_errors=True)
+        else:
+            out["telemetry_dir"] = tdir  # keep for the post-mortem
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     parser.add_argument("--url",
@@ -383,23 +567,68 @@ def main(argv=None) -> int:
     parser.add_argument("--endpoint", default="/v1/embed",
                         choices=["/v1/embed", "/v1/knn"])
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--tier", default="", choices=["", "interactive",
+                                                       "batch"],
+                        help="admission lane tag on every request "
+                             "(ISSUE 20); empty = untagged (interactive)")
     parser.add_argument("--fleet", default="",
                         help="fleet mode: comma-separated replica counts "
                              "(e.g. 1,2,4); everything after -- is one "
                              "replica's base command")
+    parser.add_argument("--fleet-args", default="",
+                        help="extra serve_fleet.py flags, one string "
+                             "(e.g. \"--ann-shards 4\")")
     parser.add_argument("--kill-drill", action="store_true",
                         help="fleet mode: SIGKILL one replica mid-load "
                              "at counts > 1 (lost must stay 0)")
     parser.add_argument("--kill-after-s", type=float, default=1.0)
+    parser.add_argument("--autoscale-drill", action="store_true",
+                        help="step drill: batch surge -> scale up -> "
+                             "idle -> drain-reap (see module docstring); "
+                             "arm the autoscaler via --fleet-args")
+    parser.add_argument("--base-replicas", type=int, default=1,
+                        help="autoscale drill: replicas at boot (the "
+                             "floor the fleet must reap back down to)")
+    parser.add_argument("--drill-timeout-s", type=float, default=180.0,
+                        help="autoscale drill: max wait for the "
+                             "drain-reap back to the floor")
     parser.add_argument("replica_cmd", nargs=argparse.REMAINDER,
                         help="fleet mode: -- then one replica's command")
     args = parser.parse_args(argv)
 
+    cmd = args.replica_cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    fleet_extra = args.fleet_args.split() if args.fleet_args else []
+
+    if args.autoscale_drill:
+        if not cmd:
+            parser.error("--autoscale-drill needs `-- <replica command>`")
+        out = run_autoscale_drill(
+            cmd,
+            base_replicas=args.base_replicas,
+            concurrency=args.concurrency,
+            total_requests=args.requests,
+            image_size=args.image_size,
+            pool=args.pool,
+            timeout_s=args.timeout_s,
+            deadline_ms=args.deadline_ms,
+            seed=args.seed,
+            drill_timeout_s=args.drill_timeout_s,
+            fleet_args=fleet_extra,
+        )
+        record = {
+            "metric": "serve_autoscale_drill",
+            "value": 1.0 if out.get("pass") else 0.0,
+            "unit": "pass",
+            "vs_baseline": 0.0,
+            "detail": out,
+        }
+        print(json.dumps(record))
+        return 0 if out.get("pass") else 1
+
     if args.fleet:
         counts = tuple(int(c) for c in args.fleet.split(",") if c.strip())
-        cmd = args.replica_cmd
-        if cmd and cmd[0] == "--":
-            cmd = cmd[1:]
         if not counts or not cmd:
             parser.error("--fleet needs counts AND `-- <replica command>`")
         rows = run_fleet_bench(
@@ -412,8 +641,10 @@ def main(argv=None) -> int:
             deadline_ms=args.deadline_ms,
             endpoint=args.endpoint,
             seed=args.seed,
+            tier=args.tier,
             kill_drill=args.kill_drill,
             kill_after_s=args.kill_after_s,
+            fleet_args=fleet_extra,
         )
         complete = [r for r in rows if "error" not in r]
         best = max((r["throughput_rps"] for r in complete), default=0.0)
@@ -442,6 +673,7 @@ def main(argv=None) -> int:
         timeout_s=args.timeout_s,
         endpoint=args.endpoint,
         seed=args.seed,
+        tier=args.tier,
     )
     record = {
         "metric": "serve_embed_p95_latency_ms",

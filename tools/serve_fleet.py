@@ -23,6 +23,16 @@ steps are verified, corrupt ones quarantined to `.quarantine/`, and
 verified ones rolled across the fleet via each replica's
 `POST /admin/reload` — zero dropped requests.
 
+`--ann-shards N` (ISSUE 20) partitions a bank's IVF index across the
+fleet: replica i serves cell partition i%N (`--ann-shard`/`--ann-shards`
+appended to its command) and the router scatter-gathers `/v1/knn`
+across one healthy owner per shard, merging top-k under the request's
+deadline — shards that miss it are dropped and the answer is flagged
+`partial: true`. `--autoscale-max > 0` arms the telemetry-driven
+autoscaler: sustained shed/depth/p99 breaches in the router_stats
+stream spawn replicas up to the budget, sustained idle drains-then-
+reaps down to max(--autoscale-min, shard cover).
+
 `--chaos`/`--chaos-replica` install a drill fault (e.g.
 `kill_at_request=200`, `wedge_at_request=200`) on ONE replica via
 MOCO_TPU_CHAOS, with fire-once state persisted per replica dir so the
@@ -105,6 +115,39 @@ def build_parser() -> argparse.ArgumentParser:
                    help="router_stats emit cadence — the autoscaler/obsd "
                         "input stream (cumulative per-code sheds, "
                         "outstanding depth, latency p50/p95/p99)")
+    p.add_argument("--ann-shards", type=int, default=0,
+                   help="ANN cell partitions (ISSUE 20): replica i "
+                        "serves shard i%%N of the bank's IVF index and "
+                        "the router scatter-gathers /v1/knn; 0 = every "
+                        "replica answers exact/full-index kNN alone. "
+                        "Requires --replicas >= this and replica "
+                        "commands with --ann-cells")
+    p.add_argument("--autoscale-max", type=int, default=0,
+                   help="replica budget for telemetry-driven "
+                        "autoscaling; 0 disables the autoscaler")
+    p.add_argument("--autoscale-min", type=int, default=1,
+                   help="never reap below this many replicas (ANN "
+                        "shard cover raises the effective floor)")
+    p.add_argument("--autoscale-cooldown-s", type=float, default=60.0,
+                   help="minimum gap between scale actions")
+    p.add_argument("--autoscale-up-after", type=int, default=2,
+                   help="consecutive breached stats windows before a "
+                        "scale-up")
+    p.add_argument("--autoscale-down-after", type=int, default=6,
+                   help="consecutive idle stats windows before a "
+                        "drain-then-reap")
+    p.add_argument("--autoscale-shed-high", type=float, default=0.02,
+                   help="windowed shed-rate breach threshold")
+    p.add_argument("--autoscale-outstanding-high", type=float,
+                   default=4.0,
+                   help="in-flight depth per healthy replica breach "
+                        "threshold")
+    p.add_argument("--autoscale-p99-high-ms", type=float, default=0.0,
+                   help="p99 latency breach threshold in ms; 0 disables "
+                        "the latency trigger")
+    p.add_argument("--autoscale-idle-low", type=float, default=0.25,
+                   help="depth per healthy replica below this (with "
+                        "zero sheds) counts as an idle window")
     p.add_argument("--chaos", default="",
                    help="drill fault spec for ONE replica, e.g. "
                         "kill_at_request=200 (see resilience/chaos.py)")
@@ -128,10 +171,37 @@ def main(argv=None) -> int:
     if args.replicas < 1:
         info(f"config error: --replicas must be >= 1, got {args.replicas}")
         return EXIT_CONFIG_ERROR
+    if args.ann_shards < 0:
+        info(f"config error: --ann-shards must be >= 0, "
+             f"got {args.ann_shards}")
+        return EXIT_CONFIG_ERROR
+    if args.ann_shards and args.replicas < args.ann_shards:
+        info(f"config error: --ann-shards {args.ann_shards} needs at "
+             f"least that many replicas to cover every cell partition, "
+             f"got --replicas {args.replicas}")
+        return EXIT_CONFIG_ERROR
+    if args.autoscale_max:
+        if args.autoscale_min < 1:
+            info(f"config error: --autoscale-min must be >= 1, "
+                 f"got {args.autoscale_min}")
+            return EXIT_CONFIG_ERROR
+        if args.autoscale_max < max(args.autoscale_min, args.replicas):
+            info(f"config error: --autoscale-max {args.autoscale_max} "
+                 f"below max(--autoscale-min {args.autoscale_min}, "
+                 f"--replicas {args.replicas})")
+            return EXIT_CONFIG_ERROR
+        if args.autoscale_cooldown_s < 0:
+            info("config error: --autoscale-cooldown-s must be >= 0")
+            return EXIT_CONFIG_ERROR
+        if args.autoscale_up_after < 1 or args.autoscale_down_after < 1:
+            info("config error: --autoscale-up-after and "
+                 "--autoscale-down-after must be >= 1")
+            return EXIT_CONFIG_ERROR
 
     def child_argv(index: int, port: int, telemetry_dir: str,
                    pretrained: str | None,
-                   bank: str | None = None) -> list:
+                   bank: str | None = None,
+                   shard: int | None = None) -> list:
         out = list(cmd) + ["--port", str(port),
                            "--telemetry-dir", telemetry_dir]
         if pretrained:
@@ -143,6 +213,11 @@ def main(argv=None) -> int:
             # a relaunch must boot on the (weights, bank) PAIR, never
             # new weights over the boot-time bank
             out += ["--knn-bank", bank]
+        if shard is not None and args.ann_shards:
+            # sharded ANN (ISSUE 20): pin the replica's cell partition
+            # so a relaunch comes back serving ITS shard
+            out += ["--ann-shard", str(shard),
+                    "--ann-shards", str(args.ann_shards)]
         return out
 
     replica_env = {}
@@ -169,6 +244,15 @@ def main(argv=None) -> int:
         watch_poll_secs=args.watch_poll_secs,
         reload_timeout_s=args.reload_timeout_s,
         stats_every_secs=args.stats_every_secs,
+        autoscale_min=args.autoscale_min,
+        autoscale_max=args.autoscale_max,
+        autoscale_cooldown_s=args.autoscale_cooldown_s,
+        autoscale_up_after=args.autoscale_up_after,
+        autoscale_down_after=args.autoscale_down_after,
+        autoscale_shed_high=args.autoscale_shed_high,
+        autoscale_outstanding_high=args.autoscale_outstanding_high,
+        autoscale_p99_high_ms=args.autoscale_p99_high_ms,
+        autoscale_idle_low=args.autoscale_idle_low,
     )
     fleet = FleetSupervisor(
         child_argv,
@@ -181,6 +265,7 @@ def main(argv=None) -> int:
         watch_dir=args.watch_dir,
         bank_dir=args.bank_dir,
         replica_env=replica_env,
+        ann_shards=args.ann_shards,
     )
     try:
         fleet.start()

@@ -601,7 +601,11 @@ def _summarize_fleet(fleet: list[dict], serves: list[dict]) -> dict:
                       "upstream_error", "shed_deadline_router",
                       "passthrough_non_200", "healthy",
                       # ISSUE 12 autoscaler-schema fields
-                      "outstanding", "latency_ms", "window", "interval_s")
+                      "outstanding", "latency_ms", "window", "interval_s",
+                      # ISSUE 20 tier/sharded-kNN fields
+                      "requests_interactive", "requests_batch",
+                      "knn_fanout", "knn_partial", "ann_shards",
+                      "knn_merge_ms")
             if k in last
         }
         reqs = router.get("requests", 0)
@@ -610,7 +614,26 @@ def _summarize_fleet(fleet: list[dict], serves: list[dict]) -> dict:
                 + router.get("upstream_error", 0)
                 + router.get("shed_deadline_router", 0))
         router["shed_rate"] = round(shed / reqs, 4) if reqs else 0.0
+        fanout = router.get("knn_fanout", 0)
+        if fanout:
+            router["knn_partial_rate"] = round(
+                router.get("knn_partial", 0) / fanout, 4)
         sec["router"] = router
+    # autoscale lifecycle (ISSUE 20): the actions and the last reason
+    scaled = [r for r in fleet
+              if str(r.get("event", "")).startswith("autoscale_")]
+    if scaled:
+        counts: dict[str, int] = {}
+        for r in scaled:
+            name = str(r.get("event"))
+            counts[name] = counts.get(name, 0) + 1
+        sec["autoscale"] = {
+            "events": counts,
+            "last": {k: scaled[-1][k]
+                     for k in ("event", "replica", "shard", "reason",
+                               "replicas", "t")
+                     if k in scaled[-1]},
+        }
     reload_events = ("reload_detected", "reload_replica", "reload_done",
                      "reload_failed", "reload_quarantine",
                      "reload_bad_layout")
@@ -943,6 +966,27 @@ def render(summary: dict) -> str:
                 f"({cache.get('hits', 0)} hit / {cache.get('misses', 0)} "
                 f"miss, {cache.get('entries', 0)} entries)"
             )
+        tiers = srv.get("tiers")
+        if tiers:
+            per = " · ".join(
+                f"{t} {c.get('submitted', 0)} submitted "
+                f"({c.get('shed_overload', 0)}+{c.get('shed_deadline', 0)} "
+                f"shed)"
+                for t, c in sorted(tiers.items())
+            )
+            lines.append(f"  tiers: {per}")
+        ann = srv.get("ann")
+        if ann:
+            recall = ann.get("recall_probe")
+            lines.append(
+                f"ann: shard {ann.get('shard', 0)}/{ann.get('shards', 1)} "
+                f"— {ann.get('owned_rows', '?')} rows in "
+                f"{ann.get('cells', '?')} cells (nprobe "
+                f"{ann.get('nprobe', '?')}, rerank {ann.get('rerank', '?')})"
+                + (f" · recall@1 probe {recall:.4f}"
+                   if isinstance(recall, (int, float)) else "")
+                + f" · {ann.get('candidate_calls', 0)} candidate call(s)"
+            )
     flt = summary.get("fleet")
     if flt:
         router = flt.get("router", {})
@@ -960,6 +1004,33 @@ def render(summary: dict) -> str:
                 f"p95 {lat.get('p95', 0):.1f} ms · "
                 f"p99 {lat.get('p99', 0):.1f} ms · outstanding "
                 f"{router.get('outstanding', 0)}"
+            )
+        if "requests_interactive" in router or "requests_batch" in router:
+            lines.append(
+                f"  tiers: {router.get('requests_interactive', 0)} "
+                f"interactive / {router.get('requests_batch', 0)} batch"
+            )
+        if router.get("knn_fanout"):
+            merge = router.get("knn_merge_ms") or {}
+            lines.append(
+                f"  knn fan-out ({router.get('ann_shards', '?')} shards): "
+                f"{router['knn_fanout']} scatter(s), "
+                f"{router.get('knn_partial', 0)} partial "
+                f"({100 * router.get('knn_partial_rate', 0):.2f}%)"
+                + (f" · merge p95 {merge.get('p95', 0):.1f} ms"
+                   if merge else "")
+            )
+        scale = flt.get("autoscale")
+        if scale:
+            counts = scale.get("events", {})
+            last = scale.get("last", {})
+            lines.append(
+                "autoscale: "
+                + " · ".join(f"{k.replace('autoscale_', '')} ×{v}"
+                             for k, v in sorted(counts.items()))
+                + (f" — last: {last.get('event', '?')} replica "
+                   f"{last.get('replica', '?')} ({last.get('reason', '')})"
+                   if last else "")
             )
         for idx, rep in sorted(flt.get("replicas", {}).items()):
             counts: dict[str, int] = {}
@@ -1218,11 +1289,22 @@ def render_record(rec: dict) -> str | None:
         return " ".join(parts)
     if kind == "serve":
         lat = rec.get("latency_ms") or {}
-        return (
+        line = (
             f"serve: {rec.get('served', 0)}/{rec.get('requests', 0)} served"
             f" · p95 {lat.get('p95', 0):.1f} ms · queue "
             f"{rec.get('queue_depth', 0)}"
         )
+        ann = rec.get("ann")
+        if isinstance(ann, dict):
+            # sharded ANN (ISSUE 20): the recall probe rides the tail so
+            # a quantizer degrading after a swap jumps out of the stream
+            recall = ann.get("recall_probe")
+            line += (
+                f" · ann {ann.get('shard', 0)}/{ann.get('shards', 1)}"
+                + (f" recall {recall:.3f}"
+                   if isinstance(recall, (int, float)) else "")
+            )
+        return line
     if kind == "run_start":
         return (f"run_start: {rec.get('name', '?')} arch="
                 f"{rec.get('arch', '?')} batch={rec.get('batch_size', '?')}"
